@@ -1,0 +1,32 @@
+"""distributed_sigmoid_loss_tpu — a TPU-native (JAX/XLA/pjit/shard_map) framework with
+the capabilities of the reference ``ahmdtaha/distributed_sigmoid_loss``.
+
+Built from scratch for TPU: the compute path is pure-functional JAX jitted onto the MXU,
+the communication path is XLA collectives (``jax.lax.all_gather`` / ``jax.lax.ppermute``)
+over a ``jax.sharding.Mesh``, and the learnable temperature/bias scalars are replicated
+optax parameters.
+
+Public surface (mirrors the reference component inventory, see SURVEY.md §2):
+
+- :mod:`.ops.sigmoid_loss` — the paper's Algorithm 1 as pure functions (single device).
+- :mod:`.parallel.collectives` — differentiable neighbor exchange (ring P2P) built on
+  ``ppermute`` (reference: distributed_utils.py).
+- :mod:`.parallel.allgather_loss` — the all-gather variant
+  (reference: distributed_sigmoid_loss.py ``DDPSigmoidLoss``).
+- :mod:`.parallel.ring_loss` — the ring / neighbor-exchange variant
+  (reference: rwightman_sigmoid_loss.py ``SigLipLoss``).
+- :mod:`.models` — toy linear towers (reference test harness) plus real ViT + text
+  transformer towers for the SigLIP training target (in progress).
+- :mod:`.train` — pjit train step, optax optimizer wiring, orbax checkpointing
+  (in progress).
+"""
+
+__version__ = "0.1.0"
+
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import (  # noqa: F401
+    init_loss_params,
+    pairwise_logits,
+    sigmoid_xent,
+    sigmoid_loss,
+    sigmoid_loss_block,
+)

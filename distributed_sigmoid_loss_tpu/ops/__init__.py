@@ -1,0 +1,8 @@
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import (  # noqa: F401
+    init_loss_params,
+    pairwise_logits,
+    sigmoid_xent,
+    sigmoid_loss,
+    sigmoid_loss_block,
+    l2_normalize,
+)

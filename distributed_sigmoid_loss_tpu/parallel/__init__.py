@@ -1,0 +1,19 @@
+from distributed_sigmoid_loss_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    data_axis,
+)
+from distributed_sigmoid_loss_tpu.parallel.collectives import (  # noqa: F401
+    ring_shift_right,
+    ring_shift_left,
+    neighbour_exchange,
+    neighbour_exchange_bidir,
+)
+from distributed_sigmoid_loss_tpu.parallel.allgather_loss import (  # noqa: F401
+    allgather_sigmoid_loss,
+)
+from distributed_sigmoid_loss_tpu.parallel.ring_loss import (  # noqa: F401
+    ring_sigmoid_loss,
+)
+from distributed_sigmoid_loss_tpu.parallel.api import (  # noqa: F401
+    make_sharded_loss_fn,
+)

@@ -1,0 +1,75 @@
+"""User-facing entry point: turn a per-shard loss into a jitted global-batch loss.
+
+The reference's user contract is "construct the loss module, run under DDP, average
+grads" (README.md:17-20). The TPU-native contract is simpler: hand this factory a mesh
+and it returns one jit-compiled function over *global* arrays; ``shard_map`` splits them
+over the data axis, the variant's collectives stitch shards together, and the returned
+scalar is the ``pmean`` over shards — so ``jax.grad`` of it IS the DP-averaged gradient
+(the reference needs an explicit ``all_reduce(SUM)/W`` pass,
+test_distributed_sigmoid_loss.py:79-83).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Literal
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from distributed_sigmoid_loss_tpu.parallel.allgather_loss import allgather_sigmoid_loss
+from distributed_sigmoid_loss_tpu.parallel.ring_loss import ring_sigmoid_loss
+
+__all__ = ["make_sharded_loss_fn"]
+
+
+def make_sharded_loss_fn(
+    mesh: Mesh,
+    *,
+    variant: Literal["all_gather", "ring"] = "all_gather",
+    axis_name: str = "dp",
+    bidir: bool = True,
+    precision=lax.Precision.HIGHEST,
+    jit: bool = True,
+) -> Callable:
+    """Build ``loss_fn(params, zimg, ztxt) -> scalar`` over global arrays.
+
+    Args:
+      mesh: 1-D (or wider) mesh whose ``axis_name`` axis shards the batch.
+      variant: ``"all_gather"`` (reference ``DDPSigmoidLoss``) or ``"ring"``
+        (reference ``SigLipLoss``).
+      bidir: ring only — bidirectional paired hops vs unidirectional
+        (reference rwightman_sigmoid_loss.py:30, default True).
+      params: dict with scalar leaves ``t_prime`` and ``bias``
+        (see :func:`distributed_sigmoid_loss_tpu.ops.init_loss_params`).
+
+    The returned scalar is the mean over shards of the per-shard loss (each normalized
+    by local batch), i.e. exactly the quantity whose gradient the reference computes via
+    per-rank backward + ``all_reduce(SUM)/W``.
+    """
+
+    if variant == "all_gather":
+        per_shard = partial(
+            allgather_sigmoid_loss, axis_name=axis_name, precision=precision
+        )
+    elif variant == "ring":
+        per_shard = partial(
+            ring_sigmoid_loss, axis_name=axis_name, bidir=bidir, precision=precision
+        )
+    else:
+        raise ValueError(f"unknown variant: {variant!r}")
+
+    def shard_loss(params, zimg, ztxt):
+        loss = per_shard(zimg, ztxt, params["t_prime"], params["bias"])
+        return lax.pmean(loss, axis_name)
+
+    batch_spec = P(axis_name)
+    fn = shard_map(
+        shard_loss,
+        mesh=mesh,
+        in_specs=(P(), batch_spec, batch_spec),
+        out_specs=P(),
+    )
+    return jax.jit(fn) if jit else fn

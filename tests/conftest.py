@@ -1,0 +1,30 @@
+"""Test bootstrap: emulate a multi-chip TPU mesh with virtual CPU devices.
+
+The reference emulates multi-node with ``mp.spawn`` + Gloo on one machine
+(/root/reference/test_distributed_sigmoid_loss.py:125-130). The TPU-native equivalent is
+``--xla_force_host_platform_device_count=N``: N virtual CPU devices in one process, same
+XLA collective semantics as an ICI mesh, no process fan-out. Must be set before jax
+initializes, hence the env mutation at import time.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Make the repo root importable regardless of how pytest was invoked.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import jax  # noqa: E402
+
+# The env var alone is not enough: the axon TPU plugin registers itself regardless, so
+# force the platform through the config API before the backend initializes.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

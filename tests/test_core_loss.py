@@ -1,0 +1,105 @@
+"""Core single-device loss vs an independent NumPy oracle of the paper's Algorithm 1.
+
+Oracle strategy mirrors the reference's (SURVEY.md §4): world_size=1 reduces the
+distributed loss to Algorithm 1 exactly, so a from-scratch NumPy implementation of
+``-log_sigmoid(labels * (t*z_img@z_txt.T + b))`` is the ground truth for values and
+(via finite differences on the scalars) gradients.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import (
+    init_loss_params,
+    l2_normalize,
+    sigmoid_loss,
+    sigmoid_loss_block,
+)
+
+
+def numpy_sigmoid_loss(zimg, ztxt, t_prime, bias, negative_only=False):
+    """Independent oracle: SigLIP Algorithm 1 in NumPy (float64)."""
+    zimg = zimg.astype(np.float64)
+    ztxt = ztxt.astype(np.float64)
+    logits = np.exp(t_prime) * zimg @ ztxt.T + bias
+    labels = -np.ones((zimg.shape[0], ztxt.shape[0]))
+    if not negative_only:
+        labels += 2.0 * np.eye(zimg.shape[0], ztxt.shape[0])
+    # stable -log(sigmoid(x)) = log1p(exp(-x)) for x>0 else -x + log1p(exp(x))
+    x = labels * logits
+    loss = np.where(x > 0, np.log1p(np.exp(-np.abs(x))), -x + np.log1p(np.exp(-np.abs(x))))
+    return loss.sum() / zimg.shape[0]
+
+
+@pytest.mark.parametrize("b,d", [(3, 2), (4, 128), (8, 512), (16, 64)])
+def test_loss_value_matches_numpy_oracle(b, d):
+    rng = np.random.default_rng(0)
+    zimg = l2_normalize(jnp.asarray(rng.standard_normal((b, d)), jnp.float32))
+    ztxt = l2_normalize(jnp.asarray(rng.standard_normal((b, d)), jnp.float32))
+    params = init_loss_params()
+
+    got = sigmoid_loss(zimg, ztxt, params["t_prime"], params["bias"])
+    want = numpy_sigmoid_loss(
+        np.asarray(zimg), np.asarray(ztxt), float(params["t_prime"]), float(params["bias"])
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_negative_only_block():
+    rng = np.random.default_rng(1)
+    zimg = l2_normalize(jnp.asarray(rng.standard_normal((4, 8)), jnp.float32))
+    ztxt = l2_normalize(jnp.asarray(rng.standard_normal((4, 8)), jnp.float32))
+    p = init_loss_params()
+    got = sigmoid_loss_block(zimg, ztxt, p["t_prime"], p["bias"], negative_only=True)
+    want = numpy_sigmoid_loss(
+        np.asarray(zimg), np.asarray(ztxt), float(p["t_prime"]), float(p["bias"]),
+        negative_only=True,
+    )
+    # Slightly looser: the all-negative loss is a sum of near-zero logsigmoid terms,
+    # so fp32 round-off dominates the relative error.
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4)
+
+
+def test_param_inits_match_reference():
+    # t_prime = log(10), bias = -10.0: reference distributed_sigmoid_loss.py:11-12.
+    p = init_loss_params()
+    np.testing.assert_allclose(float(p["t_prime"]), np.log(10.0), rtol=1e-7)
+    assert float(p["bias"]) == -10.0
+
+
+def test_scalar_grads_match_finite_differences():
+    rng = np.random.default_rng(2)
+    b, d = 6, 32
+    zimg = l2_normalize(jnp.asarray(rng.standard_normal((b, d)), jnp.float32))
+    ztxt = l2_normalize(jnp.asarray(rng.standard_normal((b, d)), jnp.float32))
+    p = init_loss_params()
+
+    grads = jax.grad(
+        lambda pp: sigmoid_loss(zimg, ztxt, pp["t_prime"], pp["bias"])
+    )(p)
+
+    eps = 1e-3
+    zi, zt = np.asarray(zimg), np.asarray(ztxt)
+    for key in ("t_prime", "bias"):
+        hi = dict(t_prime=float(p["t_prime"]), bias=float(p["bias"]))
+        lo = dict(hi)
+        hi[key] += eps
+        lo[key] -= eps
+        fd = (
+            numpy_sigmoid_loss(zi, zt, hi["t_prime"], hi["bias"])
+            - numpy_sigmoid_loss(zi, zt, lo["t_prime"], lo["bias"])
+        ) / (2 * eps)
+        np.testing.assert_allclose(float(grads[key]), fd, rtol=1e-3)
+
+
+def test_l2_normalize_matches_torch_semantics():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((5, 7)).astype(np.float32)
+    got = np.asarray(l2_normalize(jnp.asarray(x)))
+    want = F.normalize(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
