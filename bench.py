@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Headline benchmark: SigLIP ViT-B/16 train-step throughput (image-text pairs/sec/chip).
+
+Runs the full flagship train step — ViT-B/16 + text transformer + ring sigmoid loss +
+adamw update — on the real TPU chip at the per-chip batch of the BASELINE.json north
+star (global batch 32768 on a v5e-64 pod = 512 pairs/chip) and prints ONE JSON line.
+
+The reference publishes no benchmark numbers (BASELINE.md); the ``vs_baseline`` ratio is
+measured throughput vs the A100 ballpark for open_clip-style ViT-B/16 contrastive
+training (~1100 pairs/sec/GPU, bf16) — the north-star gate is vs_baseline >= 1.5.
+"""
+
+import json
+import sys
+import time
+
+A100_REF_PAIRS_PER_SEC = 1100.0  # open_clip ViT-B/16 A100 bf16 ballpark (no published ref)
+
+
+def main():
+    per_chip_batch = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+
+    import jax
+    import jax.numpy as jnp
+
+    # Persistent compile cache: the ViT-B/16 step takes minutes to compile on the
+    # tunneled chip the first time; subsequent bench runs reuse the executable.
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import (
+        LossConfig,
+        SigLIPConfig,
+        TrainConfig,
+    )
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev)
+    cfg = SigLIPConfig.b16()
+    model = SigLIP(cfg)
+    tx = make_optimizer(TrainConfig(warmup_steps=100, total_steps=100_000))
+
+    global_b = per_chip_batch * n_dev
+
+    # Generate the batch ON the device: the tunneled chip makes host->device transfer
+    # of hundreds of MB the bottleneck, and the metric is step compute, not host IO.
+    @jax.jit
+    def make_batch(key):
+        ki, kt = jax.random.split(key)
+        images = jax.random.normal(
+            ki,
+            (global_b, cfg.vision.image_size, cfg.vision.image_size, 3),
+            jnp.float32,
+        )
+        tokens = jax.random.randint(
+            kt, (global_b, cfg.text.context_length), 0, cfg.text.vocab_size, jnp.int32
+        )
+        return {"images": images, "tokens": tokens}
+
+    batch = make_batch(jax.random.key(0))
+
+    state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+    # Throughput path: ring variant, bf16 matmuls in the loss.
+    step, shardings = make_train_step(
+        model, mesh, LossConfig(variant="ring", precision="default")
+    )
+    batch = jax.device_put(batch, shardings)
+
+    # Warmup (compile + first steps).
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    pairs_per_sec_per_chip = global_b * steps / dt / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "siglip_vitb16_train_pairs_per_sec_per_chip",
+                "value": round(pairs_per_sec_per_chip, 2),
+                "unit": "pairs/s/chip",
+                "vs_baseline": round(pairs_per_sec_per_chip / A100_REF_PAIRS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -15,10 +15,14 @@ Public surface (mirrors the reference component inventory, see SURVEY.md §2):
   (reference: distributed_sigmoid_loss.py ``DDPSigmoidLoss``).
 - :mod:`.parallel.ring_loss` — the ring / neighbor-exchange variant
   (reference: rwightman_sigmoid_loss.py ``SigLipLoss``).
+- :mod:`.parallel.ring_attention` — sequence-parallel exact attention over the same
+  ppermute ring topology (long-context path).
+- :mod:`.ops.pallas_sigmoid_loss` — fused Pallas TPU kernel for the loss hot op.
 - :mod:`.models` — toy linear towers (reference test harness) plus real ViT + text
-  transformer towers for the SigLIP training target (in progress).
-- :mod:`.train` — pjit train step, optax optimizer wiring, orbax checkpointing
-  (in progress).
+  transformer towers for the SigLIP training target.
+- :mod:`.train` — pjit train step, optax optimizer wiring, orbax checkpointing.
+- :mod:`.data` / :mod:`.utils` — synthetic data pipeline, configs, parity-data recipe,
+  metrics logging, profiling.
 """
 
 __version__ = "0.1.0"

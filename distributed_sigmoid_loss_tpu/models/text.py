@@ -1,0 +1,44 @@
+"""Text tower: non-causal transformer over tokenized captions (SigLIP-style), with MAP
+pooling and projection into the shared embedding space. Embedding normalization stays
+outside the model (reference convention, test_distributed_sigmoid_loss.py:96-101)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_sigmoid_loss_tpu.models.transformer import Encoder, MapHead, _dtype
+from distributed_sigmoid_loss_tpu.utils.config import TextConfig
+
+
+class TextTransformer(nn.Module):
+    cfg: TextConfig
+
+    @nn.compact
+    def __call__(self, token_ids):
+        """token_ids: (batch, context_length) int32 → (batch, embed_dim)."""
+        cfg = self.cfg
+        dtype = _dtype(cfg.dtype)
+
+        emb = nn.Embed(
+            cfg.vocab_size,
+            cfg.width,
+            embedding_init=nn.initializers.normal(stddev=0.02),
+            name="token_embed",
+        )(token_ids)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, cfg.context_length, cfg.width),
+            jnp.float32,
+        )
+        x = emb.astype(dtype) + pos.astype(dtype)
+
+        x = Encoder(
+            cfg.width, cfg.depth, cfg.num_heads, cfg.mlp_ratio, dtype,
+            remat=cfg.remat, scan_layers=cfg.scan_layers, name="encoder",
+        )(x)
+
+        x = MapHead(cfg.width, cfg.num_heads, cfg.mlp_ratio, dtype, name="map_head")(x)
+        x = nn.Dense(cfg.embed_dim, dtype=dtype, name="proj")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,27 @@
+"""Toy linear towers — the reference test harness's stand-in encoders.
+
+Reference: ``nn.Linear(emb_dim, 2, bias=False)`` applied to seeded random inputs
+(/root/reference/test_distributed_sigmoid_loss.py:71-76). Kept as both a flax module
+(for train-state plumbing tests) and a bare function (for parity tests that hand-carry
+torch-initialized weights).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+
+def toy_tower_apply(weight: jax.Array, x: jax.Array) -> jax.Array:
+    """``x @ W.T`` with torch ``nn.Linear`` weight layout (out_dim, in_dim)."""
+    return x @ weight.T
+
+
+class LinearTower(nn.Module):
+    """Bias-free linear projection tower (torch ``nn.Linear(d, out, bias=False)``)."""
+
+    output_dim: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.output_dim, use_bias=False, name="proj")(x)

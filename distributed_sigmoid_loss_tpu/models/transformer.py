@@ -1,0 +1,184 @@
+"""Shared transformer core for both towers — designed for TPU from the start.
+
+The reference has no model layer (its "towers" are toy Linears); the BASELINE.json
+end-to-end target adds ViT-B/16 + text transformer. This core is built TPU-first:
+
+- **MXU-friendly**: fused QKV projection (one big matmul), bf16 activations with fp32
+  params, static shapes throughout.
+- **Tensor parallelism**: weight kernels carry ``nn.with_partitioning`` annotations over
+  the ``"tp"`` mesh axis — attention heads and MLP hidden dim are sharded, so under jit
+  XLA inserts the all-reduces (Megatron-style column→row split) automatically.
+- **Memory**: optional ``nn.remat`` per block (rematerialize activations in backward)
+  and ``nn.scan`` over layers (constant compile time in depth).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# Mesh axis name used by tensor-parallel kernel annotations (parallel/mesh.py).
+TP_AXIS = "tp"
+
+
+def _dtype(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
+
+
+class Mlp(nn.Module):
+    width: int
+    mlp_ratio: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        hidden = self.width * self.mlp_ratio
+        # Column-parallel in, row-parallel out: the tp all-reduce happens once, after wo.
+        wi = nn.Dense(
+            hidden,
+            dtype=self.dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.xavier_uniform(), (None, TP_AXIS)
+            ),
+            name="wi",
+        )
+        wo = nn.Dense(
+            self.width,
+            dtype=self.dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.xavier_uniform(), (TP_AXIS, None)
+            ),
+            name="wo",
+        )
+        return wo(nn.gelu(wi(x), approximate=True))
+
+
+class Attention(nn.Module):
+    width: int
+    num_heads: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x_q, x_kv=None):
+        x_kv = x_q if x_kv is None else x_kv
+        head_dim = self.width // self.num_heads
+
+        qkv_init = nn.with_partitioning(nn.initializers.xavier_uniform(), (None, TP_AXIS))
+        out_init = nn.with_partitioning(nn.initializers.xavier_uniform(), (TP_AXIS, None))
+
+        q = nn.Dense(self.width, dtype=self.dtype, kernel_init=qkv_init, name="q")(x_q)
+        k = nn.Dense(self.width, dtype=self.dtype, kernel_init=qkv_init, name="k")(x_kv)
+        v = nn.Dense(self.width, dtype=self.dtype, kernel_init=qkv_init, name="v")(x_kv)
+
+        def split(t):
+            return t.reshape(t.shape[:-1] + (self.num_heads, head_dim))
+
+        q, k, v = split(q), split(k), split(v)
+        # (batch, q_len, heads, head_dim) x (batch, kv_len, heads, head_dim)
+        attn = jnp.einsum("...qhd,...khd->...hqk", q, k) / jnp.sqrt(head_dim).astype(
+            self.dtype
+        )
+        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("...hqk,...khd->...qhd", attn, v)
+        out = out.reshape(out.shape[:-2] + (self.width,))
+        return nn.Dense(self.width, dtype=self.dtype, kernel_init=out_init, name="out")(out)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block."""
+
+    width: int
+    num_heads: int
+    mlp_ratio: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.width, self.num_heads, self.dtype, name="attn")(
+            nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        )
+        x = x + Mlp(self.width, self.mlp_ratio, self.dtype, name="mlp")(
+            nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        )
+        return x
+
+
+class _ScanBody(nn.Module):
+    """Scan-compatible block wrapper: ``(carry, _) -> (carry, None)``."""
+
+    width: int
+    num_heads: int
+    mlp_ratio: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, carry, _):
+        carry = Block(
+            self.width, self.num_heads, self.mlp_ratio, self.dtype, name="block"
+        )(carry)
+        return carry, None
+
+
+class Encoder(nn.Module):
+    """Stack of blocks; optionally remat'd and scanned over depth."""
+
+    width: int
+    depth: int
+    num_heads: int
+    mlp_ratio: int
+    dtype: Any
+    remat: bool = False
+    scan_layers: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        if self.scan_layers:
+            body_cls = _ScanBody
+            if self.remat:
+                # prevent_cse=False is safe (and faster) under scan.
+                body_cls = nn.remat(_ScanBody, prevent_cse=False, static_argnums=())
+            # One set of stacked params, compiled once: lax.scan over depth.
+            scanned = nn.scan(
+                body_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=self.depth,
+                metadata_params={nn.PARTITION_NAME: None},
+            )
+            x, _ = scanned(
+                self.width, self.num_heads, self.mlp_ratio, self.dtype, name="blocks"
+            )(x, None)
+        else:
+            block_cls = nn.remat(Block) if self.remat else Block
+            for i in range(self.depth):
+                x = block_cls(
+                    self.width, self.num_heads, self.mlp_ratio, self.dtype,
+                    name=f"block{i}",
+                )(x)
+        return nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+
+
+class MapHead(nn.Module):
+    """SigLIP's MAP (multihead attention pooling) head: a learned probe token attends
+    over the sequence, followed by an MLP residual."""
+
+    width: int
+    num_heads: int
+    mlp_ratio: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, tokens):
+        b = tokens.shape[0]
+        probe = self.param(
+            "probe", nn.initializers.xavier_uniform(), (1, 1, self.width), jnp.float32
+        ).astype(self.dtype)
+        probe = jnp.broadcast_to(probe, (b, 1, self.width))
+        x = Attention(self.width, self.num_heads, self.dtype, name="attn")(probe, tokens)
+        x = x + Mlp(self.width, self.mlp_ratio, self.dtype, name="mlp")(
+            nn.LayerNorm(dtype=self.dtype, name="ln")(x)
+        )
+        return x[:, 0]

@@ -1,0 +1,58 @@
+"""ViT image tower (BASELINE.json configs #4/#5: ViT-B/16, ViT-L/14).
+
+Patchify is a strided conv — XLA lowers it to one MXU matmul over (patches × 3·p²).
+Output is the L2-normalizable image embedding; normalization stays OUTSIDE the model,
+matching the reference's convention of normalizing outside the loss
+(/root/reference/test_distributed_sigmoid_loss.py:96-101, README.md release note).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_sigmoid_loss_tpu.models.transformer import Encoder, MapHead, _dtype
+from distributed_sigmoid_loss_tpu.utils.config import ViTConfig
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        """images: (batch, H, W, 3) → (batch, embed_dim) unnormalized embeddings."""
+        cfg = self.cfg
+        dtype = _dtype(cfg.dtype)
+        x = images.astype(dtype)
+
+        x = nn.Conv(
+            cfg.width,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            dtype=dtype,
+            name="patch_embed",
+        )(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, h * w, cfg.width),
+            jnp.float32,
+        )
+        x = x + pos.astype(dtype)
+
+        x = Encoder(
+            cfg.width, cfg.depth, cfg.num_heads, cfg.mlp_ratio, dtype,
+            remat=cfg.remat, scan_layers=cfg.scan_layers, name="encoder",
+        )(x)
+
+        if cfg.pool == "map":
+            x = MapHead(cfg.width, cfg.num_heads, cfg.mlp_ratio, dtype, name="map_head")(x)
+        else:
+            x = x.mean(axis=1)
+
+        x = nn.Dense(cfg.embed_dim, dtype=dtype, name="proj")(x)
+        return x.astype(jnp.float32)

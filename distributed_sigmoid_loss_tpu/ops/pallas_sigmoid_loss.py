@@ -1,0 +1,266 @@
+"""Fused Pallas TPU kernel for the sigmoid-loss hot op.
+
+The loss block (reference distributed_sigmoid_loss.py:22-33) is a matmul → scale/shift →
+logsigmoid → reduce chain. XLA fuses most of it, but for large text chunks the (b × n)
+logit matrix still round-trips HBM between forward and backward. This kernel computes
+the scalar loss tile-by-tile in VMEM — logits never touch HBM — and the custom VJP
+recomputes tiles in the backward pass (flash-attention-style rematerialization applied
+to contrastive logits).
+
+Layout: grid over text tiles; the image block stays resident in VMEM; each step does one
+(b × TILE_N) MXU matmul and a VPU softplus reduction into a scalar accumulator. TPU grid
+execution is sequential, so the accumulation is race-free.
+
+Used by both distributed variants (the all-gather's per-chunk loss and the ring's
+per-hop block loss). Falls back to the XLA path for shapes that don't meet TPU tiling
+constraints (see :func:`pallas_compatible`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "fused_block_loss_sum",
+    "fused_block_loss_or_none",
+    "pallas_compatible",
+    "NEGATIVE_ONLY_OFFSET",
+]
+
+# Sentinel "positive diagonal offset" that never matches any column: the whole block is
+# negatives (ring hops after the first). Exactly representable in float32.
+NEGATIVE_ONLY_OFFSET = -(2 ** 24)
+
+
+def pallas_compatible(b: int, n: int, d: int, tile_n: int = 256) -> bool:
+    """TPU tiling constraints for the fused kernel (fp32: sublane 8, lane 128)."""
+    tile = min(tile_n, n)
+    return (
+        b % 8 == 0
+        and d % 128 == 0
+        and n % tile == 0
+        and tile % 128 == 0
+    )
+
+
+def _fwd_kernel(tp_ref, bias_ref, off_ref, zimg_ref, ztxt_ref, out_ref):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[0, 0] = 0.0
+
+    b, tile_n = zimg_ref.shape[0], ztxt_ref.shape[0]
+    t = jnp.exp(tp_ref[0])
+    raw = jax.lax.dot_general(
+        zimg_ref[:],
+        ztxt_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    logits = raw * t + bias_ref[0]
+    rows = lax.broadcasted_iota(jnp.int32, (b, tile_n), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (b, tile_n), 1) + j * tile_n
+    labels = jnp.where(cols == rows + jnp.int32(off_ref[0]), 1.0, -1.0)
+    # -log_sigmoid(x) == softplus(-x)
+    out_ref[0, 0] += jnp.sum(jax.nn.softplus(-labels * logits))
+
+
+def _bwd_kernel(
+    tp_ref, bias_ref, off_ref, g_ref,
+    zimg_ref, ztxt_ref,
+    dzimg_ref, dztxt_ref, dtp_ref, dbias_ref,
+):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        dzimg_ref[:] = jnp.zeros_like(dzimg_ref)
+        dtp_ref[0, 0] = 0.0
+        dbias_ref[0, 0] = 0.0
+
+    b, tile_n = zimg_ref.shape[0], ztxt_ref.shape[0]
+    t = jnp.exp(tp_ref[0])
+    raw = jax.lax.dot_general(
+        zimg_ref[:],
+        ztxt_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    logits = raw * t + bias_ref[0]
+    rows = lax.broadcasted_iota(jnp.int32, (b, tile_n), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (b, tile_n), 1) + j * tile_n
+    labels = jnp.where(cols == rows + jnp.int32(off_ref[0]), 1.0, -1.0)
+    x = labels * logits
+    # d/dlogits of softplus(-x) with x = labels*logits: -labels * sigmoid(-x)
+    dlogits = g_ref[0] * (-labels * jax.nn.sigmoid(-x))
+
+    dzimg_ref[:] += (
+        jnp.dot(dlogits, ztxt_ref[:], preferred_element_type=jnp.float32) * t
+    )
+    dztxt_ref[:] = (
+        jax.lax.dot_general(
+            dlogits,
+            zimg_ref[:],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * t
+    )
+    dtp_ref[0, 0] += jnp.sum(dlogits * raw) * t
+    dbias_ref[0, 0] += jnp.sum(dlogits)
+
+
+def _scalar_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _vma_of(*xs) -> frozenset:
+    """Union of the inputs' varying-manual-axes (shard_map's replication typing).
+
+    Under ``jax.shard_map`` with ``check_vma=True`` (the default), ``pallas_call``
+    outputs must declare which mesh axes they vary over; the loss varies over every
+    axis any input varies over. Outside shard_map this is the empty set.
+    """
+    vma = frozenset()
+    for x in xs:
+        try:
+            vma |= jax.typeof(x).vma
+        except AttributeError:  # plain numpy input or older jax
+            pass
+    return vma
+
+
+def _align_vma(x, vma: frozenset):
+    """Upcast ``x`` to vary over every axis in ``vma`` (no-op when already varying)."""
+    missing = tuple(vma - _vma_of(x))
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
+def fused_block_loss_or_none(
+    zimg, ztxt, t_prime, bias, pos_offset, *, tile_n: int = 256
+):
+    """Dispatch helper for the distributed variants: the fused per-image-normalized
+    block loss when shapes meet the TPU tiling constraints, else ``None`` (caller
+    falls back to the XLA path). Handles shard_map vma alignment and interpret-mode
+    selection (CPU tests) in one place."""
+    b, d = zimg.shape
+    n = ztxt.shape[0]
+    tile = min(tile_n, n)
+    if not pallas_compatible(b, n, d, tile):
+        return None
+    interpret = jax.default_backend() != "tpu"
+    total = fused_block_loss_sum(
+        zimg, ztxt, t_prime, bias,
+        jnp.asarray(pos_offset, jnp.float32), tile, interpret,
+    )
+    return total / b
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def fused_block_loss_sum(zimg, ztxt, t_prime, bias, pos_offset, tile_n=256, interpret=False):
+    """SUM of ``-log_sigmoid(labels * (exp(t_prime)·zimg@ztxt.T + bias))`` over the
+    (b × n) block, positives on ``col == row + pos_offset`` (pass
+    ``NEGATIVE_ONLY_OFFSET`` for an all-negatives block). Unnormalized — divide by the
+    local batch outside, as the reference does (distributed_sigmoid_loss.py:47)."""
+    loss, _ = _fwd(zimg, ztxt, t_prime, bias, pos_offset, tile_n, interpret)
+    return loss
+
+
+def _fwd(zimg, ztxt, t_prime, bias, pos_offset, tile_n, interpret):
+    b, d = zimg.shape
+    n = ztxt.shape[0]
+    tile = min(tile_n, n)
+    assert pallas_compatible(b, n, d, tile_n), (b, n, d, tile_n)
+
+    vma = _vma_of(zimg, ztxt, t_prime, bias, pos_offset)
+    scalars = [
+        _align_vma(jnp.reshape(t_prime.astype(jnp.float32), (1,)), vma),
+        _align_vma(jnp.reshape(bias.astype(jnp.float32), (1,)), vma),
+        _align_vma(jnp.reshape(jnp.asarray(pos_offset, jnp.float32), (1,)), vma),
+    ]
+    out = pl.pallas_call(
+        _fwd_kernel,
+        grid=(n // tile,),
+        in_specs=[
+            _scalar_spec(),
+            _scalar_spec(),
+            _scalar_spec(),
+            pl.BlockSpec((b, d), lambda j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, d), lambda j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda j: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32, vma=vma),
+        interpret=interpret,
+    )(
+        *scalars,
+        _align_vma(zimg.astype(jnp.float32), vma),
+        _align_vma(ztxt.astype(jnp.float32), vma),
+    )
+    loss = out[0, 0]
+    return loss, (zimg, ztxt, t_prime, bias, pos_offset)
+
+
+def _bwd(tile_n, interpret, res, g):
+    zimg, ztxt, t_prime, bias, pos_offset = res
+    b, d = zimg.shape
+    n = ztxt.shape[0]
+    tile = min(tile_n, n)
+
+    vma = _vma_of(zimg, ztxt, t_prime, bias, pos_offset, g)
+    scalars = [
+        _align_vma(jnp.reshape(t_prime.astype(jnp.float32), (1,)), vma),
+        _align_vma(jnp.reshape(bias.astype(jnp.float32), (1,)), vma),
+        _align_vma(jnp.reshape(jnp.asarray(pos_offset, jnp.float32), (1,)), vma),
+        _align_vma(jnp.reshape(g.astype(jnp.float32), (1,)), vma),
+    ]
+    dzimg, dztxt, dtp, dbias = pl.pallas_call(
+        _bwd_kernel,
+        grid=(n // tile,),
+        in_specs=[
+            _scalar_spec(),
+            _scalar_spec(),
+            _scalar_spec(),
+            _scalar_spec(),
+            pl.BlockSpec((b, d), lambda j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, d), lambda j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((b, d), lambda j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, d), lambda j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda j: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((n, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32, vma=vma),
+        ],
+        interpret=interpret,
+    )(
+        *scalars,
+        _align_vma(zimg.astype(jnp.float32), vma),
+        _align_vma(ztxt.astype(jnp.float32), vma),
+    )
+
+    return (
+        dzimg.astype(zimg.dtype),
+        dztxt.astype(ztxt.dtype),
+        dtp[0, 0].astype(t_prime.dtype),
+        dbias[0, 0].astype(bias.dtype),
+        jnp.zeros_like(jnp.asarray(pos_offset, jnp.float32)),
+    )
+
+
+def _fwd_rule(zimg, ztxt, t_prime, bias, pos_offset, tile_n, interpret):
+    return _fwd(zimg, ztxt, t_prime, bias, pos_offset, tile_n, interpret)
+
+
+fused_block_loss_sum.defvjp(_fwd_rule, _bwd)

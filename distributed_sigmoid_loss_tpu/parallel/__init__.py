@@ -17,3 +17,7 @@ from distributed_sigmoid_loss_tpu.parallel.ring_loss import (  # noqa: F401
 from distributed_sigmoid_loss_tpu.parallel.api import (  # noqa: F401
     make_sharded_loss_fn,
 )
+from distributed_sigmoid_loss_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_self_attention,
+    make_ring_attention,
+)

@@ -38,6 +38,7 @@ def allgather_sigmoid_loss(
     *,
     axis_name: str = "dp",
     precision=lax.Precision.HIGHEST,
+    use_pallas: bool = False,
 ) -> jax.Array:
     """Per-shard loss of the all-gather variant; call inside ``shard_map``.
 
@@ -58,6 +59,18 @@ def allgather_sigmoid_loss(
     # (W, local_b, d) stacked in axis-index order, grads reduce-scatter back.
     all_txt = lax.all_gather(ztxt, axis_name)
     all_txt = all_txt.reshape(w * local_b, d)
+
+    if use_pallas:
+        from distributed_sigmoid_loss_tpu.ops.pallas_sigmoid_loss import (
+            fused_block_loss_or_none,
+        )
+
+        idx = lax.axis_index(axis_name)
+        fused = fused_block_loss_or_none(
+            zimg, all_txt, t_prime, bias, (idx * local_b).astype(jnp.float32)
+        )
+        if fused is not None:
+            return fused
 
     # One big MXU matmul instead of W small ones.
     logits = pairwise_logits(zimg, all_txt, t_prime, bias, precision=precision)

@@ -32,6 +32,7 @@ def make_sharded_loss_fn(
     axis_name: str = "dp",
     bidir: bool = True,
     precision=lax.Precision.HIGHEST,
+    use_pallas: bool = False,
     jit: bool = True,
 ) -> Callable:
     """Build ``loss_fn(params, zimg, ztxt) -> scalar`` over global arrays.
@@ -52,11 +53,14 @@ def make_sharded_loss_fn(
 
     if variant == "all_gather":
         per_shard = partial(
-            allgather_sigmoid_loss, axis_name=axis_name, precision=precision
+            allgather_sigmoid_loss,
+            axis_name=axis_name, precision=precision, use_pallas=use_pallas,
         )
     elif variant == "ring":
         per_shard = partial(
-            ring_sigmoid_loss, axis_name=axis_name, bidir=bidir, precision=precision
+            ring_sigmoid_loss,
+            axis_name=axis_name, bidir=bidir, precision=precision,
+            use_pallas=use_pallas,
         )
     else:
         raise ValueError(f"unknown variant: {variant!r}")
@@ -71,5 +75,9 @@ def make_sharded_loss_fn(
         mesh=mesh,
         in_specs=(P(), batch_spec, batch_spec),
         out_specs=P(),
+        # The pallas interpreter (CPU tests) can't yet type varying/unvarying mixes
+        # through its internal dynamic_slice; jax's own error message prescribes
+        # disabling the replication check for such bodies.
+        check_vma=not use_pallas,
     )
     return jax.jit(fn) if jit else fn

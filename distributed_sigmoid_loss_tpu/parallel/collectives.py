@@ -26,7 +26,19 @@ __all__ = [
     "ring_shift_left",
     "neighbour_exchange",
     "neighbour_exchange_bidir",
+    "pvary",
 ]
+
+
+def pvary(x: jax.Array, axis_name):
+    """Mark ``x`` as varying over ``axis_name`` under shard_map's replication typing.
+
+    Compat shim: ``lax.pvary`` is deprecated in favor of ``lax.pcast(..,
+    to='varying')``; use whichever this jax version provides.
+    """
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return lax.pvary(x, axis_name)
 
 
 def _ring_perm(world_size: int, shift: int) -> list[tuple[int, int]]:

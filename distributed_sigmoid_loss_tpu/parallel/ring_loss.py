@@ -47,6 +47,7 @@ def ring_sigmoid_loss(
     axis_name: str = "dp",
     bidir: bool = True,
     precision=lax.Precision.HIGHEST,
+    use_pallas: bool = False,
 ) -> jax.Array:
     """Per-shard loss of the ring variant; call inside ``shard_map``.
 
@@ -54,8 +55,19 @@ def ring_sigmoid_loss(
     with its variant-parity test, test_sigmoid_loss_variants.py:93-113) with a different
     communication pattern: ``W-1`` neighbor hops instead of one all-gather.
     """
-
     def block(ztxt_chunk, negative_only):
+        if use_pallas:
+            import jax.numpy as jnp
+
+            from distributed_sigmoid_loss_tpu.ops.pallas_sigmoid_loss import (
+                NEGATIVE_ONLY_OFFSET,
+                fused_block_loss_or_none,
+            )
+
+            offset = jnp.float32(NEGATIVE_ONLY_OFFSET if negative_only else 0.0)
+            fused = fused_block_loss_or_none(zimg, ztxt_chunk, t_prime, bias, offset)
+            if fused is not None:
+                return fused
         return sigmoid_loss_block(
             zimg,
             ztxt_chunk,

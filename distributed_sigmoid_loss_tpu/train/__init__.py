@@ -1,0 +1,9 @@
+from distributed_sigmoid_loss_tpu.train.train_step import (  # noqa: F401
+    make_optimizer,
+    create_train_state,
+    make_train_step,
+)
+from distributed_sigmoid_loss_tpu.train.checkpoint import (  # noqa: F401
+    save_checkpoint,
+    restore_checkpoint,
+)

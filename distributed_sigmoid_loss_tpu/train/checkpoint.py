@@ -1,0 +1,53 @@
+"""Checkpoint/resume via orbax — absent in the reference (SURVEY.md §5; the nearest
+thing is loss params riding ``state_dict`` implicitly). Here the full pjit train state
+(tower params + ``t_prime``/``bias`` + optax state + step) round-trips, sharding-aware.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+__all__ = ["save_checkpoint", "restore_checkpoint"]
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
+    """Save a train state (or any pytree of arrays) to ``path`` (a directory)."""
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=force)
+
+
+def restore_checkpoint(path: str, target: Any) -> Any:
+    """Restore into the structure/shardings of ``target`` (a matching abstract or
+    concrete train state).
+
+    Raises ``ValueError`` on shape/dtype mismatch between the stored checkpoint and
+    ``target`` — orbax's StandardCheckpointer silently returns the *stored* shapes
+    otherwise, which would surface much later as a confusing apply-time error.
+    """
+    path = os.path.abspath(path)
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, abstract)
+
+    mismatches = []
+
+    def check(keypath, want, got):
+        if hasattr(want, "shape") and (want.shape, want.dtype) != (got.shape, got.dtype):
+            mismatches.append(
+                f"  {jax.tree_util.keystr(keypath)}: checkpoint has "
+                f"{got.shape}/{got.dtype}, target expects {want.shape}/{want.dtype}"
+            )
+        return got
+
+    restored = jax.tree_util.tree_map_with_path(check, abstract, restored)
+    if mismatches:
+        raise ValueError(
+            f"checkpoint at {path} does not match the target train state:\n"
+            + "\n".join(mismatches)
+        )
+    return restored

@@ -1,0 +1,4 @@
+from distributed_sigmoid_loss_tpu.utils.parity_data import (  # noqa: F401
+    reference_partition,
+    reference_encoder_weights,
+)

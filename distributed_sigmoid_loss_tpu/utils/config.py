@@ -1,0 +1,111 @@
+"""Config dataclasses — the framework's flag system.
+
+The reference's entire config surface is constructor args and test kwargs
+(``gpu_batch_size``, ``rank/world_size/bidir``, ``emb_dim/world_size/batch_size`` —
+SURVEY.md §5). We mirror those knob names 1:1 in :class:`LossConfig` and add the model /
+train configs the BASELINE.json end-to-end targets need (ViT-B/16 + text transformer,
+global batch 4096-32768).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal
+
+
+@dataclasses.dataclass(frozen=True)
+class LossConfig:
+    """Distributed sigmoid loss knobs (reference constructor args)."""
+
+    variant: Literal["all_gather", "ring"] = "ring"
+    bidir: bool = True  # rwightman_sigmoid_loss.py:30
+    axis_name: str = "dp"
+    # HIGHEST = fp32 accumulation for parity gates; DEFAULT = bf16 for throughput.
+    precision: str = "highest"
+    # Fused Pallas loss kernel (falls back to XLA for non-tileable shapes).
+    use_pallas: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    """Image tower. Defaults = ViT-B/16 (BASELINE.json config #4)."""
+
+    image_size: int = 224
+    patch_size: int = 16
+    width: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    embed_dim: int = 512  # shared image-text embedding space
+    pool: Literal["gap", "map"] = "map"  # SigLIP uses MAP (attention-pool) heads
+    dtype: str = "bfloat16"  # activation dtype on TPU; params stay fp32
+    remat: bool = True  # jax.checkpoint each block: trade FLOPs for HBM
+    scan_layers: bool = True  # lax.scan over blocks: O(1) compile in depth
+
+    @classmethod
+    def vit_b16(cls, **kw) -> "ViTConfig":
+        return cls(**kw)
+
+    @classmethod
+    def vit_l14(cls, **kw) -> "ViTConfig":
+        return cls(patch_size=14, width=1024, depth=24, num_heads=16, **kw)
+
+    @classmethod
+    def tiny_test(cls) -> "ViTConfig":
+        return cls(
+            image_size=16, patch_size=8, width=32, depth=2, num_heads=2,
+            embed_dim=16, dtype="float32", remat=False, scan_layers=False,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TextConfig:
+    """Text tower: non-causal transformer over tokenized captions (SigLIP-style)."""
+
+    vocab_size: int = 32000
+    context_length: int = 64
+    width: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    embed_dim: int = 512
+    dtype: str = "bfloat16"
+    remat: bool = True
+    scan_layers: bool = True
+
+    @classmethod
+    def base(cls, **kw) -> "TextConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny_test(cls) -> "TextConfig":
+        return cls(
+            vocab_size=64, context_length=8, width=32, depth=2, num_heads=2,
+            embed_dim=16, dtype="float32", remat=False, scan_layers=False,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SigLIPConfig:
+    vision: ViTConfig = dataclasses.field(default_factory=ViTConfig)
+    text: TextConfig = dataclasses.field(default_factory=TextConfig)
+    loss: LossConfig = dataclasses.field(default_factory=LossConfig)
+
+    @classmethod
+    def b16(cls) -> "SigLIPConfig":
+        return cls()
+
+    @classmethod
+    def tiny_test(cls) -> "SigLIPConfig":
+        return cls(vision=ViTConfig.tiny_test(), text=TextConfig.tiny_test())
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 1e-3
+    weight_decay: float = 1e-4
+    warmup_steps: int = 2000
+    total_steps: int = 100_000
+    b1: float = 0.9
+    b2: float = 0.95
+    global_batch: int = 4096

@@ -1,0 +1,38 @@
+"""Minimal metrics logging for the train loop (SURVEY.md §5: the reference has only
+commented-out grad prints; the plan is scalar loss/t/bias + pairs/sec logging while
+keeping the loss function pure)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Mapping
+
+__all__ = ["MetricsLogger"]
+
+
+class MetricsLogger:
+    """JSON-lines metrics logger with steps/sec tracking.
+
+    Keeps host-side state only; call with already-materialized scalars so it never
+    forces an early device sync inside the step.
+    """
+
+    def __init__(self, stream: IO | None = None, every: int = 1):
+        self.stream = stream or sys.stdout
+        self.every = every
+        self._last_time: float | None = None
+        self._last_step: int | None = None
+
+    def log(self, step: int, metrics: Mapping[str, float]) -> None:
+        if step % self.every:
+            return
+        now = time.perf_counter()
+        record = {"step": step}
+        record.update({k: float(v) for k, v in metrics.items()})
+        if self._last_time is not None and step > self._last_step:
+            record["steps_per_sec"] = (step - self._last_step) / (now - self._last_time)
+        self._last_time, self._last_step = now, step
+        self.stream.write(json.dumps(record) + "\n")
+        self.stream.flush()

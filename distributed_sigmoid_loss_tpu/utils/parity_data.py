@@ -1,0 +1,64 @@
+"""Deterministic data/weight recipe matching the reference test harness, so JAX runs see
+byte-identical inputs to the PyTorch reference.
+
+The reference generates the FULL global batch on every rank and slices its shard
+(/root/reference/test_distributed_sigmoid_loss.py:57-68): images from ``torch.randn``
+under seed 42, texts under seed 40. Toy towers are ``nn.Linear(emb_dim, 2, bias=False)``
+seeded 42 for BOTH image and text encoders, so they start with identical weights
+(test_distributed_sigmoid_loss.py:71-76).
+
+torch is only needed by the parity tests; the import is lazy so the core framework has
+no torch dependency.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _require_torch():
+    try:
+        import torch  # noqa: F401
+
+        return torch
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "torch is required for the reference-parity data recipe "
+            "(pip extra: distributed-sigmoid-loss-tpu[test])"
+        ) from e
+
+
+def reference_partition(
+    world_size: int, gpu_batch_size: int, emb_dim: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Global (W*b, d) image and text inputs with the reference's seeds (42 / 40).
+
+    Returns the FULL global batch (the reference slices per rank; under shard_map the
+    mesh does the slicing, so callers hand the global arrays straight to the sharded
+    loss).
+    """
+    torch = _require_torch()
+    torch.manual_seed(42)
+    image_inputs = torch.randn(world_size * gpu_batch_size, emb_dim)
+    torch.manual_seed(40)
+    text_inputs = torch.randn(world_size * gpu_batch_size, emb_dim)
+    return image_inputs.numpy(), text_inputs.numpy()
+
+
+def reference_encoder_weights(emb_dim: int, output_dim: int = 2) -> tuple[np.ndarray, np.ndarray]:
+    """Toy tower weights, shape (output_dim, emb_dim), applied as ``x @ W.T``.
+
+    Both towers seeded 42 ⇒ identical init, matching ``get_encoders``
+    (test_distributed_sigmoid_loss.py:71-76).
+    """
+    torch = _require_torch()
+    import torch.nn as nn
+
+    torch.manual_seed(42)
+    image_encoder = nn.Linear(emb_dim, output_dim, bias=False)
+    torch.manual_seed(42)
+    text_encoder = nn.Linear(emb_dim, output_dim, bias=False)
+    return (
+        image_encoder.weight.detach().numpy().copy(),
+        text_encoder.weight.detach().numpy().copy(),
+    )

@@ -1,0 +1,42 @@
+"""Profiling & timing utilities (SURVEY.md §5: absent in the reference; TPU-native plan
+is ``jax.profiler`` traces + a ``block_until_ready`` throughput harness)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable
+
+import jax
+
+__all__ = ["trace", "time_step", "throughput"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a Perfetto/XPlane trace of the enclosed region (view with TensorBoard or
+    ui.perfetto.dev)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def time_step(fn: Callable, *args, warmup: int = 2, iters: int = 10) -> float:
+    """Median-free wall-clock of ``fn(*args)`` per call, in seconds, with compile and
+    warmup excluded and device work fully drained."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def throughput(fn: Callable, *args, items_per_call: int, **kw) -> float:
+    """Items/sec of a jitted callable (e.g. image-text pairs/sec of a train step)."""
+    return items_per_call / time_step(fn, *args, **kw)

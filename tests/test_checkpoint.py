@@ -1,0 +1,53 @@
+"""Orbax checkpoint/resume roundtrip of the full train state (SURVEY.md §5 plan)."""
+
+import tempfile
+
+import numpy as np
+import jax
+import pytest
+
+from distributed_sigmoid_loss_tpu.models import SigLIP
+from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+from distributed_sigmoid_loss_tpu.train import (
+    create_train_state,
+    make_optimizer,
+    make_train_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from distributed_sigmoid_loss_tpu.utils.config import LossConfig, SigLIPConfig, TrainConfig
+
+from test_train_step import tiny_batch
+
+
+def test_checkpoint_roundtrip_resumes_training():
+    pytest.importorskip("orbax.checkpoint")
+    cfg = SigLIPConfig.tiny_test()
+    mesh = make_mesh(2)
+    model = SigLIP(cfg)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=100))
+    batch = tiny_batch(4, cfg)
+
+    state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+    step, shardings = make_train_step(model, mesh, LossConfig(variant="ring"))
+    batch = jax.device_put(batch, shardings)
+    state, _ = step(state, batch)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/ckpt_step1"
+        save_checkpoint(path, state)
+        # Fresh state, then restore into it.
+        fresh = create_train_state(jax.random.key(1), model, tx, batch, mesh)
+        restored = restore_checkpoint(path, fresh)
+
+    assert int(restored.step) == int(state.step) == 1
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(restored.params),
+        jax.device_get(state.params),
+    )
+
+    # Resumed state continues training identically to the original.
+    s1, m1 = step(state, batch)
+    s2, m2 = step(restored, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)

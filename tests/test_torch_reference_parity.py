@@ -1,0 +1,108 @@
+"""Oracle #3 (BASELINE.md build target): cross-framework parity vs PyTorch.
+
+The reference proves W=N ≡ W=1 (test_distributed_sigmoid_loss.py:122-141), so the
+single-process PyTorch run of the toy pipeline — seeded data → Linear towers →
+L2-normalize → Algorithm 1 loss → backward — is the gold gradient for every world size.
+We reimplement that pipeline here in torch (independently, from the paper's algorithm)
+and require the JAX sharded variants to match its tower gradients at rtol<1e-4, tighter
+than the reference's own rtol=1e-3 gate.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import init_loss_params, l2_normalize  # noqa: E402
+from distributed_sigmoid_loss_tpu.parallel import make_mesh, make_sharded_loss_fn  # noqa: E402
+from distributed_sigmoid_loss_tpu.utils.parity_data import (  # noqa: E402
+    reference_partition,
+    reference_encoder_weights,
+)
+
+RTOL = 1e-4
+
+
+def torch_gold_grads(world_size, gpu_batch_size, emb_dim):
+    """Single-process torch run of the toy pipeline (reference W=1 oracle)."""
+    img_np, txt_np = reference_partition(world_size, gpu_batch_size, emb_dim)
+    wi_np, wt_np = reference_encoder_weights(emb_dim)
+
+    wi = torch.tensor(wi_np, requires_grad=True)
+    wt = torch.tensor(wt_np, requires_grad=True)
+    t_prime = torch.tensor(float(np.log(10.0)), requires_grad=True)
+    bias = torch.tensor(-10.0, requires_grad=True)
+
+    zimg = TF.normalize(torch.tensor(img_np) @ wi.T)
+    ztxt = TF.normalize(torch.tensor(txt_np) @ wt.T)
+
+    b = zimg.shape[0]
+    logits = torch.exp(t_prime) * zimg @ ztxt.T + bias
+    labels = 2 * torch.eye(b) - torch.ones(b, b)
+    loss = (-TF.logsigmoid(labels * logits)).sum() / b
+    loss.backward()
+    return (
+        float(loss.detach()),
+        wi.grad.numpy(),
+        wt.grad.numpy(),
+        float(t_prime.grad),
+        float(bias.grad),
+    )
+
+
+def jax_sharded_grads(world_size, gpu_batch_size, emb_dim, variant):
+    img_np, txt_np = reference_partition(world_size, gpu_batch_size, emb_dim)
+    wi_np, wt_np = reference_encoder_weights(emb_dim)
+    mesh = make_mesh(world_size)
+    loss_fn = make_sharded_loss_fn(mesh, variant=variant)
+
+    params = {
+        "loss": init_loss_params(),
+        "wi": jnp.asarray(wi_np),
+        "wt": jnp.asarray(wt_np),
+    }
+    img = jnp.asarray(img_np)
+    txt = jnp.asarray(txt_np)
+
+    def objective(p):
+        zimg = l2_normalize(img @ p["wi"].T)
+        ztxt = l2_normalize(txt @ p["wt"].T)
+        return loss_fn(p["loss"], zimg, ztxt)
+
+    loss, grads = jax.value_and_grad(objective)(params)
+    return (
+        float(loss),
+        np.asarray(grads["wi"]),
+        np.asarray(grads["wt"]),
+        float(grads["loss"]["t_prime"]),
+        float(grads["loss"]["bias"]),
+    )
+
+
+# Reference configs (test_distributed_sigmoid_loss.py:144-148 and
+# test_sigmoid_loss_variants.py:116-119) plus a wider 8-way config.
+CONFIGS = [
+    (3, 1, 2),     # W=3, global batch 3
+    (2, 2, 2),     # W=2, global batch 4
+    (2, 2, 128),
+    (2, 2, 512),
+    (8, 4, 64),
+]
+
+
+@pytest.mark.parametrize("world_size,gpu_batch_size,emb_dim", CONFIGS)
+@pytest.mark.parametrize("variant", ["all_gather", "ring"])
+def test_jax_sharded_matches_torch_reference(world_size, gpu_batch_size, emb_dim, variant):
+    t_loss, t_wi, t_wt, t_tp, t_b = torch_gold_grads(world_size, gpu_batch_size, emb_dim)
+    j_loss, j_wi, j_wt, j_tp, j_b = jax_sharded_grads(
+        world_size, gpu_batch_size, emb_dim, variant
+    )
+
+    np.testing.assert_allclose(j_loss, t_loss, rtol=RTOL)
+    np.testing.assert_allclose(j_wi, t_wi, rtol=RTOL, atol=1e-5, err_msg="image tower grad")
+    np.testing.assert_allclose(j_wt, t_wt, rtol=RTOL, atol=1e-5, err_msg="text tower grad")
+    np.testing.assert_allclose(j_tp, t_tp, rtol=RTOL)
+    np.testing.assert_allclose(j_b, t_b, rtol=RTOL)
