@@ -1,0 +1,140 @@
+"""Migration layer: the reference's class APIs, 1:1, on the TPU-native core.
+
+For users switching from ``ahmdtaha/distributed_sigmoid_loss`` — same class names,
+same constructor knobs, same parameter placement split:
+
+- :class:`DDPSigmoidLoss` owns ``t_prime``/``bias`` (reference
+  distributed_sigmoid_loss.py:8-15 keeps them as module params).
+- :class:`SigLipLoss` takes ``logit_scale``/``logit_bias`` as call arguments
+  (reference rwightman_sigmoid_loss.py:68).
+
+JAX is functional, so instead of implicit module state + ``.backward()``, each class
+exposes ``init_params()`` and a pure ``apply`` — the standard flax-style split. The
+``rank``/``world_size``/process-group machinery disappears: a ``Mesh`` replaces it, and
+every ``__call__`` takes **global** batch arrays (the mesh shards them).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import init_loss_params
+from distributed_sigmoid_loss_tpu.parallel.api import make_sharded_loss_fn
+from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+__all__ = ["DDPSigmoidLoss", "SigLipLoss"]
+
+
+class DDPSigmoidLoss:
+    """All-gather variant with reference-compatible surface.
+
+    Reference: ``DDPSigmoidLoss(gpu_batch_size)`` (distributed_sigmoid_loss.py:8).
+    ``gpu_batch_size`` is accepted for signature parity and validated against the mesh
+    (under ``shard_map`` the local batch is global/W automatically); pass ``None`` to
+    skip the check.
+
+    Usage::
+
+        loss_mod = DDPSigmoidLoss(gpu_batch_size=64, mesh=mesh)
+        params = loss_mod.init_params()          # {'t_prime': log 10, 'bias': -10}
+        loss, grads = jax.value_and_grad(loss_mod.apply)(params, zimg, ztxt)
+
+    ``params`` must ride your optimizer, same contract as the reference README
+    (README.md:20).
+    """
+
+    def __init__(
+        self,
+        gpu_batch_size: int | None = None,
+        mesh: Mesh | None = None,
+        axis_name: str = "dp",
+        use_pallas: bool = False,
+    ):
+        self.gpu_batch_size = gpu_batch_size
+        self.mesh = mesh if mesh is not None else make_mesh(axis_name=axis_name)
+        self.axis_name = axis_name
+        self._fn = make_sharded_loss_fn(
+            self.mesh, variant="all_gather", axis_name=axis_name, use_pallas=use_pallas
+        )
+
+    def init_params(self, dtype=jnp.float32) -> dict:
+        return init_loss_params(dtype)
+
+    def apply(self, params: dict, image_embeddings, text_embeddings):
+        """Global (B, d) L2-normalized embeddings → scalar loss (mean over shards of
+        per-shard sums / local batch, exactly the reference's DP-averaged quantity)."""
+        self._check(image_embeddings)
+        return self._fn(params, image_embeddings, text_embeddings)
+
+    __call__ = apply
+
+    def _check(self, x):
+        if self.gpu_batch_size is not None:
+            w = self.mesh.shape[self.axis_name]
+            if x.shape[0] != self.gpu_batch_size * w:
+                raise ValueError(
+                    f"global batch {x.shape[0]} != gpu_batch_size "
+                    f"({self.gpu_batch_size}) x world_size ({w})"
+                )
+
+
+class SigLipLoss:
+    """Ring / neighbor-exchange variant with reference-compatible surface.
+
+    Reference: ``SigLipLoss(cache_labels, rank, world_size, bidir, use_horovod)``
+    (rwightman_sigmoid_loss.py:23-30). ``rank``/``world_size`` are subsumed by the
+    mesh (accepted and validated for parity); ``cache_labels`` is a no-op exactly like
+    the reference's dead cache state (rwightman_sigmoid_loss.py:39-41 — labels are
+    constants under jit anyway); horovod is unsupported there and here.
+
+    Usage::
+
+        loss_mod = SigLipLoss(mesh=mesh, bidir=True)
+        loss = loss_mod.apply(params, zimg, ztxt)   # params: logit_scale/logit_bias
+    """
+
+    def __init__(
+        self,
+        cache_labels: bool = False,
+        rank: int | None = None,
+        world_size: int | None = None,
+        bidir: bool = True,
+        use_horovod: bool = False,
+        mesh: Mesh | None = None,
+        axis_name: str = "dp",
+        use_pallas: bool = False,
+    ):
+        if use_horovod:
+            # Reference: `assert not use_horovod` (rwightman_sigmoid_loss.py:35).
+            raise NotImplementedError("horovod is not supported (matching reference)")
+        del cache_labels, rank  # signature parity only
+        self.mesh = mesh if mesh is not None else make_mesh(axis_name=axis_name)
+        self.axis_name = axis_name
+        self.bidir = bidir
+        w = self.mesh.shape[axis_name]
+        if world_size is not None and world_size != w:
+            raise ValueError(f"world_size={world_size} but mesh has {w} devices")
+        self._fn = make_sharded_loss_fn(
+            self.mesh, variant="ring", axis_name=axis_name, bidir=bidir,
+            use_pallas=use_pallas,
+        )
+
+    def apply(self, params: dict, image_features, text_features, output_dict=False):
+        """``params = {'logit_scale': log-temperature, 'logit_bias': bias}`` — the
+        reference passes these as external tensors (rwightman_sigmoid_loss.py:68);
+        ``logit_scale`` ≡ ``t_prime``."""
+        loss = self._fn(
+            {"t_prime": params["logit_scale"], "bias": params["logit_bias"]},
+            image_features,
+            text_features,
+        )
+        return {"contrastive_loss": loss} if output_dict else loss
+
+    __call__ = apply
+
+    @staticmethod
+    def init_params(dtype=jnp.float32) -> dict:
+        p = init_loss_params(dtype)
+        return {"logit_scale": p["t_prime"], "logit_bias": p["bias"]}

@@ -57,12 +57,20 @@ class Mlp(nn.Module):
 
 
 class Attention(nn.Module):
+    """Multi-head attention; with ``sp_axis`` set, the attention core runs
+    sequence-parallel over that mesh axis via ring attention (long-context path).
+    Requires an ambient mesh (``jax.set_mesh``) containing the axis; the projections
+    stay per-token and are partitioned by GSPMD as usual."""
+
     width: int
     num_heads: int
     dtype: Any
+    sp_axis: str | None = None
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x_q, x_kv=None):
+        is_self_attention = x_kv is None
         x_kv = x_q if x_kv is None else x_kv
         head_dim = self.width // self.num_heads
 
@@ -77,12 +85,32 @@ class Attention(nn.Module):
             return t.reshape(t.shape[:-1] + (self.num_heads, head_dim))
 
         q, k, v = split(q), split(k), split(v)
-        # (batch, q_len, heads, head_dim) x (batch, kv_len, heads, head_dim)
-        attn = jnp.einsum("...qhd,...khd->...hqk", q, k) / jnp.sqrt(head_dim).astype(
-            self.dtype
-        )
-        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(self.dtype)
-        out = jnp.einsum("...hqk,...khd->...qhd", attn, v)
+        if self.sp_axis is not None and is_self_attention:
+            # Sequence-parallel exact attention: manual over sp only, GSPMD keeps
+            # handling any other mesh axes (dp/tp) automatically.
+            from functools import partial
+
+            from jax.sharding import PartitionSpec as P
+
+            from distributed_sigmoid_loss_tpu.parallel.ring_attention import (
+                ring_self_attention,
+            )
+
+            spec = P(None, self.sp_axis)
+            out = jax.shard_map(
+                partial(
+                    ring_self_attention, axis_name=self.sp_axis, causal=self.causal
+                ),
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                axis_names={self.sp_axis},
+            )(q, k, v)
+        else:
+            from distributed_sigmoid_loss_tpu.parallel.ring_attention import (
+                dense_attention,
+            )
+
+            out = dense_attention(q, k, v, causal=self.causal).astype(self.dtype)
         out = out.reshape(out.shape[:-2] + (self.width,))
         return nn.Dense(self.width, dtype=self.dtype, kernel_init=out_init, name="out")(out)
 
@@ -94,12 +122,15 @@ class Block(nn.Module):
     num_heads: int
     mlp_ratio: int
     dtype: Any
+    sp_axis: str | None = None
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x):
-        x = x + Attention(self.width, self.num_heads, self.dtype, name="attn")(
-            nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        )
+        x = x + Attention(
+            self.width, self.num_heads, self.dtype,
+            sp_axis=self.sp_axis, causal=self.causal, name="attn",
+        )(nn.LayerNorm(dtype=self.dtype, name="ln1")(x))
         x = x + Mlp(self.width, self.mlp_ratio, self.dtype, name="mlp")(
             nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         )
@@ -113,11 +144,14 @@ class _ScanBody(nn.Module):
     num_heads: int
     mlp_ratio: int
     dtype: Any
+    sp_axis: str | None = None
+    causal: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
         carry = Block(
-            self.width, self.num_heads, self.mlp_ratio, self.dtype, name="block"
+            self.width, self.num_heads, self.mlp_ratio, self.dtype,
+            sp_axis=self.sp_axis, causal=self.causal, name="block",
         )(carry)
         return carry, None
 
@@ -132,6 +166,8 @@ class Encoder(nn.Module):
     dtype: Any
     remat: bool = False
     scan_layers: bool = False
+    sp_axis: str | None = None
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -149,14 +185,15 @@ class Encoder(nn.Module):
                 metadata_params={nn.PARTITION_NAME: None},
             )
             x, _ = scanned(
-                self.width, self.num_heads, self.mlp_ratio, self.dtype, name="blocks"
+                self.width, self.num_heads, self.mlp_ratio, self.dtype,
+                sp_axis=self.sp_axis, causal=self.causal, name="blocks",
             )(x, None)
         else:
             block_cls = nn.remat(Block) if self.remat else Block
             for i in range(self.depth):
                 x = block_cls(
                     self.width, self.num_heads, self.mlp_ratio, self.dtype,
-                    name=f"block{i}",
+                    sp_axis=self.sp_axis, causal=self.causal, name=f"block{i}",
                 )(x)
         return nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
 

@@ -139,7 +139,6 @@ def make_train_step(
         raise ValueError(f"unknown loss variant: {loss_cfg.variant!r}")
 
     # Embeddings enter the loss island sharded over dp, replicated over other axes.
-    extra_axes = tuple(n for n in mesh.axis_names if n != axis)
     emb_spec = P(axis)
 
     def shard_loss(zimg, ztxt, t_prime, bias):

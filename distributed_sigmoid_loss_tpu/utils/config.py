@@ -72,6 +72,10 @@ class TextConfig:
     dtype: str = "bfloat16"
     remat: bool = True
     scan_layers: bool = True
+    # Long-context: shard the sequence over this mesh axis and run ring attention
+    # inside the blocks (requires an ambient mesh via jax.set_mesh).
+    sequence_parallel_axis: str | None = None
+    causal: bool = False
 
     @classmethod
     def base(cls, **kw) -> "TextConfig":

@@ -1,0 +1,61 @@
+"""Long-context tower: sequence-parallel (ring attention) text transformer produces the
+same embeddings as the dense tower with identical params."""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from distributed_sigmoid_loss_tpu.models import TextTransformer
+from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+from distributed_sigmoid_loss_tpu.utils.config import TextConfig
+
+
+def test_sequence_parallel_text_tower_matches_dense():
+    base = TextConfig(
+        vocab_size=64, context_length=32, width=32, depth=2, num_heads=2,
+        embed_dim=16, dtype="float32", remat=False, scan_layers=False,
+    )
+    sp = dataclasses.replace(base, sequence_parallel_axis="sp")
+
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (2, 32)), jnp.int32
+    )
+    dense_model = TextTransformer(base)
+    sp_model = TextTransformer(sp)
+
+    import flax.linen as nn
+
+    params = nn.meta.unbox(dense_model.init(jax.random.key(0), tokens)["params"])
+
+    want = dense_model.apply({"params": params}, tokens)
+
+    mesh = make_mesh(4, "sp")
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, t: sp_model.apply({"params": p}, t))(params, tokens)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
+
+
+def test_sequence_parallel_grads_flow():
+    cfg = TextConfig(
+        vocab_size=64, context_length=16, width=32, depth=1, num_heads=2,
+        embed_dim=16, dtype="float32", remat=False, scan_layers=False,
+        sequence_parallel_axis="sp",
+    )
+    tokens = jnp.asarray(np.random.default_rng(1).integers(0, 64, (2, 16)), jnp.int32)
+    model = TextTransformer(cfg)
+    mesh = make_mesh(2, "sp")
+    import flax.linen as nn
+
+    # Init through the dense twin (identical param tree) — the tp partitioning
+    # metadata can't be constrained against an sp-only mesh at init time.
+    dense_twin = TextTransformer(dataclasses.replace(cfg, sequence_parallel_axis=None))
+    params = nn.meta.unbox(dense_twin.init(jax.random.key(0), tokens)["params"])
+    with jax.set_mesh(mesh):
+        g = jax.jit(
+            jax.grad(lambda p: (model.apply({"params": p}, tokens) ** 2).sum())
+        )(params)
+    norms = [float(jnp.linalg.norm(x)) for x in jax.tree.leaves(g)]
+    assert all(np.isfinite(norms)) and max(norms) > 0

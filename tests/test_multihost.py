@@ -1,0 +1,39 @@
+"""Multi-host helpers on the emulated device set (single process)."""
+
+import jax
+import pytest
+
+from distributed_sigmoid_loss_tpu.parallel.multihost import (
+    global_batch_for,
+    initialize_multihost,
+    make_hybrid_mesh,
+)
+
+
+def test_initialize_single_process_noop():
+    idx, count = initialize_multihost()
+    assert idx == 0 and count >= 1
+
+
+def test_hybrid_mesh_shapes():
+    mesh = make_hybrid_mesh(dp_dcn=1, dp_ici=4, tp_ici=2)
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2}
+    assert global_batch_for(256, mesh) == 1024
+
+
+def test_hybrid_mesh_size_validation():
+    with pytest.raises(ValueError, match="device count"):
+        make_hybrid_mesh(dp_dcn=1, dp_ici=16, tp_ici=2)
+
+
+def test_hybrid_mesh_runs_sharded_loss():
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import init_loss_params, l2_normalize
+    from distributed_sigmoid_loss_tpu.parallel import make_sharded_loss_fn
+
+    mesh = make_hybrid_mesh(dp_dcn=1, dp_ici=2, tp_ici=4)
+    fn = make_sharded_loss_fn(mesh, variant="ring")
+    rng = np.random.default_rng(0)
+    z = l2_normalize(jnp.asarray(rng.standard_normal((8, 32)), jnp.float32))
+    assert np.isfinite(float(fn(init_loss_params(), z, z)))
