@@ -37,7 +37,8 @@ class TextTransformer(nn.Module):
         x = Encoder(
             cfg.width, cfg.depth, cfg.num_heads, cfg.mlp_ratio, dtype,
             remat=cfg.remat, scan_layers=cfg.scan_layers,
-            sp_axis=cfg.sequence_parallel_axis, causal=cfg.causal, name="encoder",
+            sp_axis=cfg.sequence_parallel_axis, sp_impl=cfg.sequence_parallel_impl,
+            causal=cfg.causal, name="encoder",
         )(x)
 
         x = MapHead(cfg.width, cfg.num_heads, cfg.mlp_ratio, dtype, name="map_head")(x)

@@ -66,6 +66,7 @@ class Attention(nn.Module):
     num_heads: int
     dtype: Any
     sp_axis: str | None = None
+    sp_impl: str = "ring"  # "ring" (ppermute) or "ulysses" (all-to-all)
     causal: bool = False
 
     @nn.compact
@@ -95,12 +96,23 @@ class Attention(nn.Module):
             from distributed_sigmoid_loss_tpu.parallel.ring_attention import (
                 ring_self_attention,
             )
+            from distributed_sigmoid_loss_tpu.parallel.ulysses_attention import (
+                ulysses_self_attention,
+            )
 
+            sp_impls = {
+                "ring": ring_self_attention,
+                "ulysses": ulysses_self_attention,
+            }
+            if self.sp_impl not in sp_impls:
+                raise ValueError(
+                    f"unknown sp_impl: {self.sp_impl!r} (expected one of "
+                    f"{sorted(sp_impls)})"
+                )
+            sp_fn = sp_impls[self.sp_impl]
             spec = P(None, self.sp_axis)
             out = jax.shard_map(
-                partial(
-                    ring_self_attention, axis_name=self.sp_axis, causal=self.causal
-                ),
+                partial(sp_fn, axis_name=self.sp_axis, causal=self.causal),
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
                 axis_names={self.sp_axis},
@@ -123,13 +135,15 @@ class Block(nn.Module):
     mlp_ratio: int
     dtype: Any
     sp_axis: str | None = None
+    sp_impl: str = "ring"
     causal: bool = False
 
     @nn.compact
     def __call__(self, x):
         x = x + Attention(
             self.width, self.num_heads, self.dtype,
-            sp_axis=self.sp_axis, causal=self.causal, name="attn",
+            sp_axis=self.sp_axis, sp_impl=self.sp_impl, causal=self.causal,
+            name="attn",
         )(nn.LayerNorm(dtype=self.dtype, name="ln1")(x))
         x = x + Mlp(self.width, self.mlp_ratio, self.dtype, name="mlp")(
             nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
@@ -145,13 +159,15 @@ class _ScanBody(nn.Module):
     mlp_ratio: int
     dtype: Any
     sp_axis: str | None = None
+    sp_impl: str = "ring"
     causal: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
         carry = Block(
             self.width, self.num_heads, self.mlp_ratio, self.dtype,
-            sp_axis=self.sp_axis, causal=self.causal, name="block",
+            sp_axis=self.sp_axis, sp_impl=self.sp_impl, causal=self.causal,
+            name="block",
         )(carry)
         return carry, None
 
@@ -167,6 +183,7 @@ class Encoder(nn.Module):
     remat: bool = False
     scan_layers: bool = False
     sp_axis: str | None = None
+    sp_impl: str = "ring"
     causal: bool = False
 
     @nn.compact
@@ -186,14 +203,16 @@ class Encoder(nn.Module):
             )
             x, _ = scanned(
                 self.width, self.num_heads, self.mlp_ratio, self.dtype,
-                sp_axis=self.sp_axis, causal=self.causal, name="blocks",
+                sp_axis=self.sp_axis, sp_impl=self.sp_impl, causal=self.causal,
+                name="blocks",
             )(x, None)
         else:
             block_cls = nn.remat(Block) if self.remat else Block
             for i in range(self.depth):
                 x = block_cls(
                     self.width, self.num_heads, self.mlp_ratio, self.dtype,
-                    sp_axis=self.sp_axis, causal=self.causal, name=f"block{i}",
+                    sp_axis=self.sp_axis, sp_impl=self.sp_impl, causal=self.causal,
+                    name=f"block{i}",
                 )(x)
         return nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
 

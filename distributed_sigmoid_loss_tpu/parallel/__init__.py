@@ -21,3 +21,7 @@ from distributed_sigmoid_loss_tpu.parallel.ring_attention import (  # noqa: F401
     ring_self_attention,
     make_ring_attention,
 )
+from distributed_sigmoid_loss_tpu.parallel.ulysses_attention import (  # noqa: F401
+    ulysses_self_attention,
+    make_ulysses_attention,
+)

@@ -37,8 +37,23 @@ def initialize_multihost(**kwargs) -> tuple[int, int]:
     else:
         try:
             jax.distributed.initialize()
-        except (RuntimeError, ValueError):
-            # Already initialized, or single-process run with no coordinator.
+        except RuntimeError as e:
+            # Benign only when the runtime is already up or there is no distributed
+            # context to join. A transient coordinator failure must propagate —
+            # swallowing it would strand every other host at the rendezvous while
+            # this one trains alone.
+            msg = str(e).lower()
+            benign = (
+                "already initialized" in msg
+                or "already been initialized" in msg
+                or "unable to detect" in msg
+                or "could not detect" in msg
+            )
+            if not benign:
+                raise
+        except ValueError:
+            # jax raises ValueError when it cannot auto-detect a coordinator (plain
+            # single-process run) — the documented no-op case.
             pass
     return jax.process_index(), jax.process_count()
 
@@ -52,18 +67,21 @@ def make_hybrid_mesh(
 ) -> Mesh:
     """(dp, tp) mesh spanning slices: dp's slow (DCN) factor outermost, tp on ICI.
 
-    ``dp_dcn=None`` infers the DCN factor as ``device_count / (dp_ici * tp_ici)``.
-    The returned mesh's dp axis has size ``dp_dcn * dp_ici``; collectives over tp
-    never leave a slice.
+    ``dp_dcn=None`` infers the DCN factor from the actual slice topology (number of
+    distinct ``slice_index`` values, falling back to 1 when devices carry no slice
+    attribute — single-slice or CPU emulation). The returned mesh's dp axis has size
+    ``dp_dcn * dp_ici``; collectives over tp never leave a slice.
     """
     n_dev = len(jax.devices())
     if dp_dcn is None:
-        inner = dp_ici * tp_ici
-        if n_dev % inner:
-            raise ValueError(
-                f"device count {n_dev} not divisible by dp_ici*tp_ici={inner}"
-            )
-        dp_dcn = n_dev // inner
+        # The DCN factor is the real slice count, NOT the leftover device factor:
+        # on a single slice (or CPU emulation, where devices carry no slice_index)
+        # the leftover belongs to dp_ici.
+        slice_ids = {getattr(d, "slice_index", 0) for d in jax.devices()}
+        dp_dcn = len(slice_ids)
+        if dp_ici == 1 and n_dev % (dp_dcn * tp_ici) == 0:
+            # dp_ici left at its default: absorb the per-slice leftover.
+            dp_ici = n_dev // (dp_dcn * tp_ici)
     if dp_dcn * dp_ici * tp_ici != n_dev:
         raise ValueError(
             f"dp_dcn*dp_ici*tp_ici = {dp_dcn * dp_ici * tp_ici} != device count {n_dev}"

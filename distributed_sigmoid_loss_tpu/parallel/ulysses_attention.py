@@ -1,0 +1,83 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second canonical long-context topology (alongside ring attention,
+parallel/ring_attention.py): instead of streaming K/V blocks around a ring, one
+``all_to_all`` re-shards the activations from sequence-sharded to *head*-sharded, every
+chip runs exact dense attention over the full sequence for its head slice, and a second
+``all_to_all`` restores sequence sharding. Comm volume is O(1) hops (two all-to-alls)
+instead of W-1 ring steps, at the cost of requiring ``num_heads % W == 0`` and holding
+the full-sequence activations for the local heads.
+
+The reference has no sequence dimension at all — its ring variant shifts the *batch*
+dimension of contrastive negatives (rwightman_sigmoid_loss.py:71-122). Ring attention
+generalizes that topology to sequence; Ulysses is the all-to-all alternative the task
+calls for. Differentiability is free: ``lax.all_to_all``'s transpose is the reverse
+all-to-all, so grads re-shard back without hand-written autograd (contrast the
+reference's custom ``NeighbourExchange`` backward, distributed_utils.py:65-98).
+
+Both entry points must run inside ``shard_map`` over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_sigmoid_loss_tpu.parallel.ring_attention import dense_attention
+
+__all__ = ["ulysses_self_attention", "make_ulysses_attention"]
+
+
+def ulysses_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact sequence-parallel attention via head-scatter / sequence-gather all-to-all.
+
+    Args:
+      q, k, v: (b, s_local, h, dh) — this shard's sequence block; the global sequence
+        is the axis-index-ordered concatenation of shards (same contract as
+        ``ring_self_attention``).
+      causal: global-position causal mask (exact: the full sequence is materialized
+        per chip after the first all-to-all).
+
+    Returns (b, s_local, h, dh). Requires ``h % axis_size == 0``.
+    """
+    w = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % w != 0:
+        raise ValueError(
+            f"ulysses requires num_heads ({h}) divisible by axis size ({w})"
+        )
+
+    # Sequence-sharded -> head-sharded: split the head axis W ways, send slice j to
+    # chip j, concatenate received sequence blocks in axis order (= global order).
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    q_g = seq_to_heads(q)  # (b, s_global, h/W, dh)
+    k_g = seq_to_heads(k)
+    v_g = seq_to_heads(v)
+
+    out = dense_attention(q_g, k_g, v_g, causal=causal, scale=scale)
+
+    # Head-sharded -> sequence-sharded (the inverse re-shard).
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def make_ulysses_attention(mesh, axis_name: str = "sp", **kw):
+    """Convenience wrapper: global (b, S, h, dh) arrays in, sequence sharded over
+    ``axis_name`` (mirror of ``make_ring_attention``)."""
+    fn = functools.partial(ulysses_self_attention, axis_name=axis_name, **kw)
+    spec = P(None, axis_name)
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    )

@@ -72,9 +72,12 @@ class TextConfig:
     dtype: str = "bfloat16"
     remat: bool = True
     scan_layers: bool = True
-    # Long-context: shard the sequence over this mesh axis and run ring attention
-    # inside the blocks (requires an ambient mesh via jax.set_mesh).
+    # Long-context: shard the sequence over this mesh axis and run sequence-parallel
+    # attention inside the blocks (requires an ambient mesh via jax.set_mesh).
     sequence_parallel_axis: str | None = None
+    # "ring" (ppermute, O(s_local²) memory) or "ulysses" (all-to-all head scatter,
+    # 2 collective hops; needs num_heads % axis_size == 0).
+    sequence_parallel_impl: Literal["ring", "ulysses"] = "ring"
     causal: bool = False
 
     @classmethod

@@ -21,6 +21,20 @@ def test_hybrid_mesh_shapes():
     assert global_batch_for(256, mesh) == 1024
 
 
+def test_hybrid_mesh_infers_single_slice():
+    # Emulated CPU devices carry no slice_index: the inferred DCN factor must be 1
+    # (slice count), with the leftover absorbed into dp_ici — not a bogus dp_dcn=8.
+    mesh = make_hybrid_mesh(tp_ici=2)
+    assert dict(mesh.shape) == {"dp": len(jax.devices()) // 2, "tp": 2}
+
+
+def test_hybrid_mesh_explicit_dp_ici_not_overridden():
+    # An explicitly passed dp_ici that doesn't fill the device count must raise,
+    # never be silently replaced.
+    with pytest.raises(ValueError, match="device count"):
+        make_hybrid_mesh(dp_ici=2, tp_ici=2)  # 1*2*2 != 8
+
+
 def test_hybrid_mesh_size_validation():
     with pytest.raises(ValueError, match="device count"):
         make_hybrid_mesh(dp_dcn=1, dp_ici=16, tp_ici=2)
