@@ -58,9 +58,10 @@ class Mlp(nn.Module):
 
 class Attention(nn.Module):
     """Multi-head attention; with ``sp_axis`` set, the attention core runs
-    sequence-parallel over that mesh axis via ring attention (long-context path).
-    Requires an ambient mesh (``jax.set_mesh``) containing the axis; the projections
-    stay per-token and are partitioned by GSPMD as usual."""
+    sequence-parallel over that mesh axis (long-context path) — ``sp_impl`` picks
+    ring (ppermute) or ulysses (all-to-all) attention. Requires an ambient mesh
+    (``jax.set_mesh``) containing the axis; the projections stay per-token and are
+    partitioned by GSPMD as usual."""
 
     width: int
     num_heads: int

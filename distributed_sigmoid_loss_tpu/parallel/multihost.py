@@ -44,23 +44,35 @@ def initialize_multihost(**kwargs) -> tuple[int, int]:
             # this one trains alone.
             msg = str(e).lower()
             benign = (
-                "already initialized" in msg
+                # Already initialized (e.g. a properly brought-up pod run calling
+                # this helper a second time): the runtime is live, nothing to do.
+                "should only be called once" in msg
+                or "already initialized" in msg
                 or "already been initialized" in msg
+                # Backend started without a distributed client: only reachable
+                # single-process (a multi-process run that computed before
+                # initializing is indistinguishable here and will surface at the
+                # peers' rendezvous timeout instead).
+                or "must be called before" in msg
+                # No coordinator to auto-detect — plain single-process run.
                 or "unable to detect" in msg
                 or "could not detect" in msg
             )
             if not benign:
                 raise
-        except ValueError:
-            # jax raises ValueError when it cannot auto-detect a coordinator (plain
-            # single-process run) — the documented no-op case.
-            pass
+        except ValueError as e:
+            # "coordinator_address should be defined" = nothing to auto-detect, the
+            # plain single-process no-op. Any other ValueError (e.g. a coordinator
+            # address present but process count missing) is a partial multi-host
+            # config — propagate rather than silently train alone.
+            if "coordinator_address" not in str(e):
+                raise
     return jax.process_index(), jax.process_count()
 
 
 def make_hybrid_mesh(
     dp_dcn: int | None = None,
-    dp_ici: int = 1,
+    dp_ici: int | None = None,
     tp_ici: int = 1,
     *,
     axis_names: tuple[str, str] = (data_axis, model_axis),
@@ -69,8 +81,10 @@ def make_hybrid_mesh(
 
     ``dp_dcn=None`` infers the DCN factor from the actual slice topology (number of
     distinct ``slice_index`` values, falling back to 1 when devices carry no slice
-    attribute — single-slice or CPU emulation). The returned mesh's dp axis has size
-    ``dp_dcn * dp_ici``; collectives over tp never leave a slice.
+    attribute — single-slice or CPU emulation). ``dp_ici=None`` absorbs whatever
+    device factor remains; an explicit ``dp_ici`` that doesn't fill the device count
+    raises. The returned mesh's dp axis has size ``dp_dcn * dp_ici``; collectives
+    over tp never leave a slice.
     """
     n_dev = len(jax.devices())
     if dp_dcn is None:
@@ -79,9 +93,13 @@ def make_hybrid_mesh(
         # the leftover belongs to dp_ici.
         slice_ids = {getattr(d, "slice_index", 0) for d in jax.devices()}
         dp_dcn = len(slice_ids)
-        if dp_ici == 1 and n_dev % (dp_dcn * tp_ici) == 0:
-            # dp_ici left at its default: absorb the per-slice leftover.
-            dp_ici = n_dev // (dp_dcn * tp_ici)
+    if dp_ici is None:
+        if n_dev % (dp_dcn * tp_ici) != 0:
+            raise ValueError(
+                f"dp_dcn*tp_ici = {dp_dcn * tp_ici} does not divide "
+                f"device count {n_dev}"
+            )
+        dp_ici = n_dev // (dp_dcn * tp_ici)
     if dp_dcn * dp_ici * tp_ici != n_dev:
         raise ValueError(
             f"dp_dcn*dp_ici*tp_ici = {dp_dcn * dp_ici * tp_ici} != device count {n_dev}"

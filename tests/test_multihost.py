@@ -33,6 +33,9 @@ def test_hybrid_mesh_explicit_dp_ici_not_overridden():
     # never be silently replaced.
     with pytest.raises(ValueError, match="device count"):
         make_hybrid_mesh(dp_ici=2, tp_ici=2)  # 1*2*2 != 8
+    with pytest.raises(ValueError, match="device count"):
+        # dp_ici=1 is an explicit request, not the "absorb leftover" default.
+        make_hybrid_mesh(dp_ici=1, tp_ici=2)  # 1*1*2 != 8
 
 
 def test_hybrid_mesh_size_validation():
