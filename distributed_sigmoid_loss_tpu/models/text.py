@@ -36,7 +36,8 @@ class TextTransformer(nn.Module):
 
         x = Encoder(
             cfg.width, cfg.depth, cfg.num_heads, cfg.mlp_ratio, dtype,
-            remat=cfg.remat, scan_layers=cfg.scan_layers,
+            remat=cfg.remat, scan_layers=cfg.scan_layers, attn_impl=cfg.attn_impl,
+            remat_policy=cfg.remat_policy,
             sp_axis=cfg.sequence_parallel_axis, sp_impl=cfg.sequence_parallel_impl,
             causal=cfg.causal, name="encoder",
         )(x)

@@ -19,6 +19,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 # Mesh axis name used by tensor-parallel kernel annotations (parallel/mesh.py).
 TP_AXIS = "tp"
@@ -26,6 +27,15 @@ TP_AXIS = "tp"
 
 def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
+
+
+def _remat_policy(name: str):
+    """None = rematerialize everything (jax.checkpoint default)."""
+    if name == "nothing":
+        return None
+    if name == "attn_out":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    raise ValueError(f"unknown remat_policy: {name!r}")
 
 
 class Mlp(nn.Module):
@@ -61,13 +71,18 @@ class Attention(nn.Module):
     sequence-parallel over that mesh axis (long-context path) — ``sp_impl`` picks
     ring (ppermute) or ulysses (all-to-all) attention. Requires an ambient mesh
     (``jax.set_mesh``) containing the axis; the projections stay per-token and are
-    partitioned by GSPMD as usual."""
+    partitioned by GSPMD as usual.
+
+    ``attn_impl`` selects the single-device core: "dense" (XLA einsum softmax),
+    "flash" (Pallas fused kernel, TPU only), or "auto" (flash on TPU when the shape
+    qualifies, dense otherwise)."""
 
     width: int
     num_heads: int
     dtype: Any
     sp_axis: str | None = None
     sp_impl: str = "ring"  # "ring" (ppermute) or "ulysses" (all-to-all)
+    attn_impl: str = "auto"  # "dense" | "flash" | "auto"
     causal: bool = False
 
     @nn.compact
@@ -119,11 +134,42 @@ class Attention(nn.Module):
                 axis_names={self.sp_axis},
             )(q, k, v)
         else:
+            from distributed_sigmoid_loss_tpu.ops.flash_attention import (
+                flash_attention_available,
+                flash_self_attention,
+            )
+            from distributed_sigmoid_loss_tpu.ops.pallas_short_attention import (
+                SHORT_ATTENTION_MAX_SEQ,
+                short_self_attention,
+            )
             from distributed_sigmoid_loss_tpu.parallel.ring_attention import (
                 dense_attention,
             )
 
-            out = dense_attention(q, k, v, causal=self.causal).astype(self.dtype)
+            # "auto" picks a fused Pallas kernel only for bf16 self-attention: the
+            # fused backward matmuls are bf16-grade, which is exactly right for
+            # bf16 training but would silently degrade an f32 parity run. Short
+            # sequences (towers) take the VMEM-resident kernel; long ones the
+            # blockwise flash kernel.
+            if self.attn_impl == "flash" and not is_self_attention:
+                raise ValueError(
+                    "attn_impl='flash' requires self-attention (the fused kernels "
+                    "assume q/k/v share one sequence); use 'auto' or 'dense' for "
+                    "cross-attention"
+                )
+            use_fused = self.attn_impl == "flash" or (
+                self.attn_impl == "auto"
+                and is_self_attention
+                and self.dtype == jnp.bfloat16
+                and flash_attention_available()
+            )
+            if use_fused and q.shape[1] <= SHORT_ATTENTION_MAX_SEQ:
+                out = short_self_attention(q, k, v, self.causal)
+            elif use_fused:
+                out = flash_self_attention(q, k, v, causal=self.causal)
+            else:
+                out = dense_attention(q, k, v, causal=self.causal)
+            out = out.astype(self.dtype)
         out = out.reshape(out.shape[:-2] + (self.width,))
         return nn.Dense(self.width, dtype=self.dtype, kernel_init=out_init, name="out")(out)
 
@@ -137,15 +183,20 @@ class Block(nn.Module):
     dtype: Any
     sp_axis: str | None = None
     sp_impl: str = "ring"
+    attn_impl: str = "auto"
     causal: bool = False
 
     @nn.compact
     def __call__(self, x):
-        x = x + Attention(
+        attn_out = Attention(
             self.width, self.num_heads, self.dtype,
-            sp_axis=self.sp_axis, sp_impl=self.sp_impl, causal=self.causal,
+            sp_axis=self.sp_axis, sp_impl=self.sp_impl,
+            attn_impl=self.attn_impl, causal=self.causal,
             name="attn",
         )(nn.LayerNorm(dtype=self.dtype, name="ln1")(x))
+        # Checkpoint-name the attention output so the "attn_out" remat policy can
+        # save it: backward then skips recomputing the whole attention chain.
+        x = x + checkpoint_name(attn_out, "attn_out")
         x = x + Mlp(self.width, self.mlp_ratio, self.dtype, name="mlp")(
             nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         )
@@ -161,13 +212,15 @@ class _ScanBody(nn.Module):
     dtype: Any
     sp_axis: str | None = None
     sp_impl: str = "ring"
+    attn_impl: str = "auto"
     causal: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
         carry = Block(
             self.width, self.num_heads, self.mlp_ratio, self.dtype,
-            sp_axis=self.sp_axis, sp_impl=self.sp_impl, causal=self.causal,
+            sp_axis=self.sp_axis, sp_impl=self.sp_impl,
+            attn_impl=self.attn_impl, causal=self.causal,
             name="block",
         )(carry)
         return carry, None
@@ -183,8 +236,12 @@ class Encoder(nn.Module):
     dtype: Any
     remat: bool = False
     scan_layers: bool = False
+    # "nothing" = full remat; "attn_out" = save attention outputs (skip recomputing
+    # attention in backward, costing b·s·width per layer of HBM).
+    remat_policy: str = "nothing"
     sp_axis: str | None = None
     sp_impl: str = "ring"
+    attn_impl: str = "auto"
     causal: bool = False
 
     @nn.compact
@@ -193,7 +250,10 @@ class Encoder(nn.Module):
             body_cls = _ScanBody
             if self.remat:
                 # prevent_cse=False is safe (and faster) under scan.
-                body_cls = nn.remat(_ScanBody, prevent_cse=False, static_argnums=())
+                body_cls = nn.remat(
+                    _ScanBody, prevent_cse=False, static_argnums=(),
+                    policy=_remat_policy(self.remat_policy),
+                )
             # One set of stacked params, compiled once: lax.scan over depth.
             scanned = nn.scan(
                 body_cls,
@@ -204,15 +264,21 @@ class Encoder(nn.Module):
             )
             x, _ = scanned(
                 self.width, self.num_heads, self.mlp_ratio, self.dtype,
-                sp_axis=self.sp_axis, sp_impl=self.sp_impl, causal=self.causal,
+                sp_axis=self.sp_axis, sp_impl=self.sp_impl,
+                attn_impl=self.attn_impl, causal=self.causal,
                 name="blocks",
             )(x, None)
         else:
-            block_cls = nn.remat(Block) if self.remat else Block
+            block_cls = (
+                nn.remat(Block, policy=_remat_policy(self.remat_policy))
+                if self.remat
+                else Block
+            )
             for i in range(self.depth):
                 x = block_cls(
                     self.width, self.num_heads, self.mlp_ratio, self.dtype,
-                    sp_axis=self.sp_axis, sp_impl=self.sp_impl, causal=self.causal,
+                    sp_axis=self.sp_axis, sp_impl=self.sp_impl,
+            attn_impl=self.attn_impl, causal=self.causal,
                     name=f"block{i}",
                 )(x)
         return nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)

@@ -46,7 +46,8 @@ class ViT(nn.Module):
 
         x = Encoder(
             cfg.width, cfg.depth, cfg.num_heads, cfg.mlp_ratio, dtype,
-            remat=cfg.remat, scan_layers=cfg.scan_layers, name="encoder",
+            remat=cfg.remat, scan_layers=cfg.scan_layers, attn_impl=cfg.attn_impl,
+            remat_policy=cfg.remat_policy, name="encoder",
         )(x)
 
         if cfg.pool == "map":

@@ -1,0 +1,98 @@
+"""Fused (flash) self-attention on TPU via the Pallas MXU kernel.
+
+The towers' dense attention materializes the (b, h, s, s) logits and f32 softmax in
+HBM — at ViT-B/16 scale that is the single largest activation (7G+ per step at
+batch 256, see the OOM allocation report) and a pure bandwidth tax. The Pallas flash
+kernel (jax.experimental.pallas.ops.tpu.flash_attention) streams K/V blocks through
+VMEM with an online softmax, so nothing O(s²) ever touches HBM, and its custom VJP
+recomputes blocks in the backward pass instead of storing them.
+
+This wrapper adapts the kernel to the towers' (b, s, h, dh) layout and to sequence
+lengths that aren't block-aligned (ViT-B/16 has s=196): inputs are zero-padded to a
+block multiple and masked via segment ids (pad tokens get a different segment id, so
+real queries never attend them; padded query rows are sliced off afterwards).
+
+There is no reference analogue (the reference has no model layer — SURVEY.md §1); this
+is TPU-first engineering for the BASELINE.json end-to-end throughput target.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_self_attention", "flash_attention_available"]
+
+# The kernel's minor-most compute tile: sequence blocks must be multiples of this to
+# satisfy the (8, 128) f32 / (16, 128) bf16 TPU tiling on the logits' lane dim.
+_SEQ_MULTIPLE = 128
+
+
+def flash_attention_available() -> bool:
+    """True when the current default backend can run the Pallas TPU kernel."""
+    return jax.default_backend() == "tpu"
+
+
+def _pad_len(s: int) -> int:
+    return (s + _SEQ_MULTIPLE - 1) // _SEQ_MULTIPLE * _SEQ_MULTIPLE
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale"))
+def flash_self_attention(q, k, v, *, causal: bool = False, scale: float | None = None):
+    """Drop-in replacement for ``dense_attention``: (b, s, h, dh) → (b, s, h, dh).
+
+    Self-attention only (q/k/v share a sequence length). Numerics match the dense
+    path (f32 online softmax) up to flash's blockwise summation order.
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        SegmentIds,
+        flash_attention,
+    )
+
+    b, s, h, dh = q.shape
+    scale = (dh**-0.5) if scale is None else scale
+    s_pad = _pad_len(s)
+
+    # Kernel layout is (b, h, s, dh).
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
+    segment_ids = None
+    if s_pad != s:
+        pad = ((0, 0), (0, 0), (0, s_pad - s), (0, 0))
+        qt, kt, vt = (jnp.pad(t, pad) for t in (qt, kt, vt))
+        # Real tokens get segment id 1, padding id 0: real queries never attend
+        # padding; padded queries attend only padding (finite softmax, rows are
+        # sliced off below).
+        ids = (jnp.arange(s_pad, dtype=jnp.int32) < s).astype(jnp.int32)
+        ids = jnp.broadcast_to(ids[None], (b, s_pad))
+        segment_ids = SegmentIds(q=ids, kv=ids)
+
+    block = min(512, s_pad)
+    block_sizes = BlockSizes(
+        block_q=block,
+        block_k_major=block,
+        block_k=block,
+        block_b=1,
+        block_q_major_dkv=block,
+        block_k_major_dkv=block,
+        block_k_dkv=block,
+        block_q_dkv=block,
+        block_k_major_dq=block,
+        block_k_dq=block,
+        block_q_dq=block,
+    )
+    out = flash_attention(
+        qt,
+        kt,
+        vt,
+        segment_ids=segment_ids,
+        causal=causal,
+        sm_scale=scale,
+        block_sizes=block_sizes,
+    )
+    return jnp.transpose(out[:, :, :s, :], (0, 2, 1, 3))

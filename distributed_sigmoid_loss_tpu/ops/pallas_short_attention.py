@@ -1,0 +1,158 @@
+"""Fused short-sequence multi-head attention — a Pallas TPU kernel for the towers.
+
+Why not the generic flash kernel: at tower scale (ViT-B/16 s=196, text s=64) the
+sequence fits in VMEM whole, so blockwise online softmax is pure overhead — the
+generic kernel's (batch, head, q-block, kv-block) grid launches thousands of tiny
+programs and loses to XLA's dense path (measured: 46ms vs 15ms per fwd+bwd call at
+b=512, s=196). What actually hurts the dense path is HBM traffic: the (b, h, s, s)
+logits and f32 softmax round-trip through HBM in forward AND backward — the largest
+activations in the whole SigLIP step (7G+ stacked across layers at batch 256).
+
+Design: the kernel consumes q/k/v in the towers' NATIVE (b, s, h·dh) layout — no
+transposes, no layout padding (a (s, width) tile is exactly aligned); one program =
+one batch row, heads handled by a static Python loop over lane slices. Everything
+O(s²) lives and dies in VMEM: logits → softmax → out in forward, the 5-matmul
+gradient chain in backward (probs recomputed, never stored). HBM traffic collapses
+to the unavoidable q/k/v/out (+gradients) reads and writes — measured 5.8× faster
+than the dense path at ViT-B/16 scale, 2.9× at text-tower scale. Numerics: f32
+logits / softmax / accumulation, matmul inputs in the activation dtype (bf16 in
+training) — the same contract as the dense path.
+
+No reference analogue (the reference has no model layer, SURVEY.md §1); this is the
+"pallas kernels for the hot ops" piece of the TPU-first design.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["short_self_attention", "SHORT_ATTENTION_MAX_SEQ"]
+
+_NEG_INF = -1e30
+
+# Above this sequence length the O(s²) per-head blocks stop fitting VMEM comfortably
+# and a blockwise (true flash / ring) kernel wins; dispatch there instead.
+SHORT_ATTENTION_MAX_SEQ = 1024
+
+
+def _dot(a, b, contract_a: int, contract_b: int):
+    return lax.dot_general(
+        a,
+        b,
+        (((contract_a,), (contract_b,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _head_probs(qh, kh, *, scale, causal):
+    logits = _dot(qh, kh, 1, 1) * scale  # (s, s)
+    if causal:
+        s = logits.shape[0]
+        rows = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        logits = jnp.where(rows >= cols, logits, _NEG_INF)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, num_heads):
+    q, k, v = q_ref[0], k_ref[0], v_ref[0]  # (s, h·dh)
+    dh = q.shape[-1] // num_heads
+    for j in range(num_heads):
+        sl = slice(j * dh, (j + 1) * dh)
+        p = _head_probs(q[:, sl], k[:, sl], scale=scale, causal=causal)
+        o_ref[0, :, sl] = _dot(p.astype(v.dtype), v[:, sl], 1, 0).astype(o_ref.dtype)
+
+
+def _bwd_kernel(
+    q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref, *, scale, causal, num_heads
+):
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    dh = q.shape[-1] // num_heads
+    for j in range(num_heads):
+        sl = slice(j * dh, (j + 1) * dh)
+        qh, kh, vh, doh = q[:, sl], k[:, sl], v[:, sl], do[:, sl]
+        # Recompute this head's probs entirely in VMEM.
+        p = _head_probs(qh, kh, scale=scale, causal=causal)  # (s, s) f32
+        p_lo = p.astype(vh.dtype)
+        do_lo = doh.astype(vh.dtype)
+        dv_ref[0, :, sl] = _dot(p_lo, do_lo, 0, 0).astype(dv_ref.dtype)  # pᵀ @ do
+        dp = _dot(do_lo, vh, 1, 1)  # (s, s): do @ vᵀ
+        # Softmax VJP: ds = p ⊙ (dp − rowsum(dp ⊙ p)), then the logits scale.
+        ds = ((p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))) * scale).astype(
+            qh.dtype
+        )
+        dq_ref[0, :, sl] = _dot(ds, kh, 1, 0).astype(dq_ref.dtype)  # ds @ k
+        dk_ref[0, :, sl] = _dot(ds, qh, 0, 0).astype(dk_ref.dtype)  # dsᵀ @ q
+
+
+def _specs(b, s, width, n: int):
+    block = pl.BlockSpec((1, s, width), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+    return dict(grid=(b,), in_specs=[block] * n, out_specs=block)
+
+
+def _flops(b, s, width, n_matmuls: int) -> int:
+    return 2 * b * s * s * width * n_matmuls
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def short_self_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                         interpret: bool = False):
+    """Fused self-attention for VMEM-resident sequences: (b, s, h, dh) → same.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU testing).
+    """
+    out, _ = _short_attention_fwd(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _short_attention_fwd(q, k, v, causal, scale, interpret):
+    b, s, h, dh = q.shape
+    scale = (dh**-0.5) if scale is None else scale
+    wide = (b, s, h * dh)  # free reshape: heads stay on the minor axis
+    spec = _specs(b, s, h * dh, 3)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, num_heads=h),
+        out_shape=jax.ShapeDtypeStruct(wide, q.dtype),
+        grid=spec["grid"],
+        in_specs=spec["in_specs"],
+        out_specs=spec["out_specs"],
+        cost_estimate=pl.CostEstimate(
+            flops=_flops(b, s, h * dh, 2),
+            bytes_accessed=4 * q.size * q.dtype.itemsize,
+            transcendentals=b * h * s * s,
+        ),
+        interpret=interpret,
+    )(q.reshape(wide), k.reshape(wide), v.reshape(wide))
+    return out.reshape(q.shape), (q, k, v)
+
+
+def _short_attention_bwd(causal, scale, interpret, residuals, g):
+    q, k, v = residuals
+    b, s, h, dh = q.shape
+    scale_v = (dh**-0.5) if scale is None else scale
+    wide = (b, s, h * dh)
+    spec = _specs(b, s, h * dh, 4)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale_v, causal=causal, num_heads=h),
+        out_shape=[jax.ShapeDtypeStruct(wide, q.dtype)] * 3,
+        grid=spec["grid"],
+        in_specs=spec["in_specs"],
+        out_specs=[spec["out_specs"]] * 3,
+        cost_estimate=pl.CostEstimate(
+            flops=_flops(b, s, h * dh, 5),
+            bytes_accessed=7 * q.size * q.dtype.itemsize,
+            transcendentals=b * h * s * s,
+        ),
+        interpret=interpret,
+    )(q.reshape(wide), k.reshape(wide), v.reshape(wide), g.reshape(wide))
+    shape = q.shape
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+
+
+short_self_attention.defvjp(_short_attention_fwd, _short_attention_bwd)

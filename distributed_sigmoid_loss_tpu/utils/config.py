@@ -41,6 +41,11 @@ class ViTConfig:
     dtype: str = "bfloat16"  # activation dtype on TPU; params stay fp32
     remat: bool = True  # jax.checkpoint each block: trade FLOPs for HBM
     scan_layers: bool = True  # lax.scan over blocks: O(1) compile in depth
+    # "auto" = fused Pallas kernel for bf16 self-attention on TPU (f32 keeps the
+    # dense path: the fused backward is bf16-grade), XLA dense softmax elsewhere.
+    attn_impl: Literal["auto", "dense", "flash"] = "auto"
+    # "nothing" = full remat; "attn_out" = save attention outputs across backward.
+    remat_policy: Literal["nothing", "attn_out"] = "nothing"
 
     @classmethod
     def vit_b16(cls, **kw) -> "ViTConfig":
@@ -72,6 +77,8 @@ class TextConfig:
     dtype: str = "bfloat16"
     remat: bool = True
     scan_layers: bool = True
+    attn_impl: Literal["auto", "dense", "flash"] = "auto"
+    remat_policy: Literal["nothing", "attn_out"] = "nothing"
     # Long-context: shard the sequence over this mesh axis and run sequence-parallel
     # attention inside the blocks (requires an ambient mesh via jax.set_mesh).
     sequence_parallel_axis: str | None = None
