@@ -33,8 +33,22 @@ def _remat_policy(name: str):
     """None = rematerialize everything (jax.checkpoint default)."""
     if name == "nothing":
         return None
-    if name == "attn_out":
-        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if name == "save_hot":
+        # Save the two expensive-to-recompute intermediates (attention core output,
+        # MLP hidden): backward recompute shrinks to qkv projections + layernorms +
+        # elementwise gelu (~25% of forward instead of 100%), costing
+        # b·s·(width + hidden) of HBM per layer.
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_core", "mlp_hidden"
+        )
+    if name == "save_all_hot":
+        # save_hot plus q/k/v: backward recompute is layernorms + gelu only.
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_core", "mlp_hidden", "q_proj", "k_proj", "v_proj"
+        )
+    if name == "save_mlp":
+        # The single biggest matmul output only — the low-memory selective option.
+        return jax.checkpoint_policies.save_only_these_names("mlp_hidden")
     raise ValueError(f"unknown remat_policy: {name!r}")
 
 
@@ -63,7 +77,10 @@ class Mlp(nn.Module):
             ),
             name="wo",
         )
-        return wo(nn.gelu(wi(x), approximate=True))
+        # Name the wi output so the "save_hot" remat policy keeps it: backward then
+        # recomputes only the cheap elementwise gelu, not the big wi matmul.
+        hidden_act = checkpoint_name(wi(x), "mlp_hidden")
+        return wo(nn.gelu(hidden_act, approximate=True))
 
 
 class Attention(nn.Module):
@@ -101,7 +118,10 @@ class Attention(nn.Module):
         def split(t):
             return t.reshape(t.shape[:-1] + (self.num_heads, head_dim))
 
-        q, k, v = split(q), split(k), split(v)
+        # Named for the "save_all_hot" remat policy (saves the projections too, so
+        # backward recompute is layernorm+gelu only).
+        q, k, v = (checkpoint_name(t, n) for t, n in
+                   ((split(q), "q_proj"), (split(k), "k_proj"), (split(v), "v_proj")))
         if self.sp_axis is not None and is_self_attention:
             # Sequence-parallel exact attention: manual over sp only, GSPMD keeps
             # handling any other mesh axes (dp/tp) automatically.
@@ -170,6 +190,10 @@ class Attention(nn.Module):
             else:
                 out = dense_attention(q, k, v, causal=self.causal)
             out = out.astype(self.dtype)
+        # Named for the "save_hot" remat policy: with the core output saved, the
+        # backward pass needs only q/k/v (for the attention VJP) — the s² core
+        # forward is never re-run.
+        out = checkpoint_name(out, "attn_core")
         out = out.reshape(out.shape[:-2] + (self.width,))
         return nn.Dense(self.width, dtype=self.dtype, kernel_init=out_init, name="out")(out)
 
@@ -188,15 +212,12 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        attn_out = Attention(
+        x = x + Attention(
             self.width, self.num_heads, self.dtype,
             sp_axis=self.sp_axis, sp_impl=self.sp_impl,
             attn_impl=self.attn_impl, causal=self.causal,
             name="attn",
         )(nn.LayerNorm(dtype=self.dtype, name="ln1")(x))
-        # Checkpoint-name the attention output so the "attn_out" remat policy can
-        # save it: backward then skips recomputing the whole attention chain.
-        x = x + checkpoint_name(attn_out, "attn_out")
         x = x + Mlp(self.width, self.mlp_ratio, self.dtype, name="mlp")(
             nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         )
@@ -236,8 +257,9 @@ class Encoder(nn.Module):
     dtype: Any
     remat: bool = False
     scan_layers: bool = False
-    # "nothing" = full remat; "attn_out" = save attention outputs (skip recomputing
-    # attention in backward, costing b·s·width per layer of HBM).
+    # "nothing" = full remat; "save_hot" = save attention-core + MLP-hidden
+    # outputs; "save_all_hot" adds q/k/v; "save_mlp" = MLP hidden only. See
+    # _remat_policy for the recompute/HBM tradeoffs.
     remat_policy: str = "nothing"
     sp_axis: str | None = None
     sp_impl: str = "ring"
@@ -278,7 +300,7 @@ class Encoder(nn.Module):
                 x = block_cls(
                     self.width, self.num_heads, self.mlp_ratio, self.dtype,
                     sp_axis=self.sp_axis, sp_impl=self.sp_impl,
-            attn_impl=self.attn_impl, causal=self.causal,
+                    attn_impl=self.attn_impl, causal=self.causal,
                     name=f"block{i}",
                 )(x)
         return nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)

@@ -72,7 +72,10 @@ def flash_self_attention(q, k, v, *, causal: bool = False, scale: float | None =
         ids = jnp.broadcast_to(ids[None], (b, s_pad))
         segment_ids = SegmentIds(q=ids, kv=ids)
 
-    block = min(512, s_pad)
+    # The kernel requires the sequence length to be divisible by the block size
+    # (both directions — backward also blocks the q dim), so pick the largest
+    # power-of-two block ≤512 that divides the padded length.
+    block = next(b for b in (512, 256, 128) if s_pad % b == 0)
     block_sizes = BlockSizes(
         block_q=block,
         block_k_major=block,

@@ -44,8 +44,9 @@ class ViTConfig:
     # "auto" = fused Pallas kernel for bf16 self-attention on TPU (f32 keeps the
     # dense path: the fused backward is bf16-grade), XLA dense softmax elsewhere.
     attn_impl: Literal["auto", "dense", "flash"] = "auto"
-    # "nothing" = full remat; "attn_out" = save attention outputs across backward.
-    remat_policy: Literal["nothing", "attn_out"] = "nothing"
+    # "nothing" = full remat; "save_hot" = save attention-core + MLP-hidden
+    # activations across backward (recompute only projections/elementwise).
+    remat_policy: Literal["nothing", "save_hot", "save_all_hot", "save_mlp"] = "nothing"
 
     @classmethod
     def vit_b16(cls, **kw) -> "ViTConfig":
@@ -78,7 +79,7 @@ class TextConfig:
     remat: bool = True
     scan_layers: bool = True
     attn_impl: Literal["auto", "dense", "flash"] = "auto"
-    remat_policy: Literal["nothing", "attn_out"] = "nothing"
+    remat_policy: Literal["nothing", "save_hot", "save_all_hot", "save_mlp"] = "nothing"
     # Long-context: shard the sequence over this mesh axis and run sequence-parallel
     # attention inside the blocks (requires an ambient mesh via jax.set_mesh).
     sequence_parallel_axis: str | None = None
