@@ -2,8 +2,9 @@
 """Headline benchmark: SigLIP ViT-B/16 train-step throughput (image-text pairs/sec/chip).
 
 Runs the full flagship train step — ViT-B/16 + text transformer + ring sigmoid loss +
-adamw update — on the real TPU chip at the per-chip batch of the BASELINE.json north
-star (global batch 32768 on a v5e-64 pod = 512 pairs/chip) and prints ONE JSON line.
+adamw update — on the real TPU chip at the measured single-chip sweet spot (256
+pairs/chip with the save_hot remat policy; the 32768-global north star maps to a
+v5e-128 or two grad-accumulation steps on v5e-64) and prints ONE JSON line.
 
 The reference publishes no benchmark numbers (BASELINE.md); the ``vs_baseline`` ratio is
 measured throughput vs the A100 ballpark for open_clip-style ViT-B/16 contrastive

@@ -1,3 +1,9 @@
+from distributed_sigmoid_loss_tpu.data.loader import (  # noqa: F401
+    batch_shardings,
+    global_batch_from_local,
+    prefetch,
+    put_batch,
+)
 from distributed_sigmoid_loss_tpu.data.synthetic import (  # noqa: F401
     SyntheticImageText,
     shard_batch,

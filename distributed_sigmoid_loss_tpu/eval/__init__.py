@@ -1,0 +1,7 @@
+from distributed_sigmoid_loss_tpu.eval.retrieval import (
+    recall_at_k,
+    retrieval_metrics,
+    retrieval_ranks,
+)
+
+__all__ = ["recall_at_k", "retrieval_metrics", "retrieval_ranks"]

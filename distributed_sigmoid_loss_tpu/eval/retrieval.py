@@ -1,0 +1,97 @@
+"""Zero-shot image↔text retrieval metrics (recall@K) — the standard SigLIP eval.
+
+The reference ships no eval (SURVEY.md §5); a contrastive framework needs one to be
+usable end-to-end. TPU-native design: embeddings stay sharded over the ``dp`` mesh
+axis; each shard computes its local (b_local × N) similarity block against the
+all-gathered text matrix and ranks the positive on the diagonal — the same
+all-gather comm pattern as the loss, reused for eval. Ranks are exact (count of
+strictly-greater similarities), so ties resolve optimistically and identical
+embeddings give recall@1 = 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
+
+__all__ = ["retrieval_ranks", "recall_at_k", "retrieval_metrics"]
+
+
+def retrieval_ranks(zimg: jax.Array, ztxt: jax.Array) -> jax.Array:
+    """Rank (0-based) of each row's positive pair: ``ranks[i]`` is the number of
+    texts scoring strictly higher than text ``i`` against image ``i``.
+
+    Single-device form; inputs are L2-normalized (N, d) arrays.
+    """
+    sims = zimg @ ztxt.T  # (N, N)
+    pos = jnp.diagonal(sims)
+    return jnp.sum(sims > pos[:, None], axis=-1)
+
+
+def recall_at_k(ranks: jax.Array, k: int) -> jax.Array:
+    return jnp.mean(ranks < k)
+
+
+def _sharded_ranks(zimg, ztxt, axis_name):
+    """Per-shard ranks of the diagonal positives; call inside ``shard_map``."""
+    all_txt = lax.all_gather(ztxt, axis_name)  # (W, b_local, d)
+    sims = jnp.einsum("id,wjd->iwj", zimg, all_txt)  # (b_local, W, b_local)
+    # Rows shard identically on both sides, so local image row i's positive is
+    # local text row i of this same shard. Read it OUT of sims (not via a separate
+    # exact elementwise product): on TPU the MXU similarity and an elementwise
+    # recomputation differ at bf16 grade, which would make positives count as
+    # strictly greater than themselves.
+    own_block = lax.dynamic_index_in_dim(
+        sims, lax.axis_index(axis_name), axis=1, keepdims=False
+    )  # (b_local, b_local)
+    pos = jnp.diagonal(own_block)
+    return jnp.sum(sims > pos[:, None, None], axis=(1, 2))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_ranks_fn(mesh: Mesh, axis_name: str):
+    """Cached so repeated evals reuse the compiled executable (jit caches by
+    function object identity — rebuilding the shard_map each call would recompile
+    every time)."""
+    return jax.jit(
+        jax.shard_map(
+            partial(_sharded_ranks, axis_name=axis_name),
+            mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name)),
+            out_specs=P(axis_name),
+        )
+    )
+
+
+def retrieval_metrics(
+    zimg: jax.Array,
+    ztxt: jax.Array,
+    mesh: Mesh | None = None,
+    ks: tuple[int, ...] = (1, 5, 10),
+    axis_name: str = data_axis,
+) -> dict[str, jax.Array]:
+    """Image→text and text→image recall@K over the global batch.
+
+    With a ``mesh``, embeddings are sharded over ``axis_name`` and the similarity
+    matrix is computed blockwise per shard (all-gather pattern); without one, the
+    plain single-device path runs.
+    """
+    if mesh is None:
+        i2t = retrieval_ranks(zimg, ztxt)
+        t2i = retrieval_ranks(ztxt, zimg)
+    else:
+        fn = _sharded_ranks_fn(mesh, axis_name)
+        i2t = fn(zimg, ztxt)
+        t2i = fn(ztxt, zimg)
+    out = {}
+    for k in ks:
+        out[f"i2t_recall@{k}"] = recall_at_k(i2t, k)
+        out[f"t2i_recall@{k}"] = recall_at_k(t2i, k)
+    return out

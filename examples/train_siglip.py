@@ -79,6 +79,16 @@ def main():
         logger.log(i, {k: float(v) for k, v in metrics.items()})
         batch = jax.device_put(next(data), shardings)
 
+    # Zero-shot retrieval on a held-out synthetic batch (the model normalizes its
+    # embeddings already).
+    from distributed_sigmoid_loss_tpu.eval import retrieval_metrics
+
+    zimg, ztxt, _ = model.apply(
+        {"params": state.params}, batch["images"], batch["tokens"]
+    )
+    rm = retrieval_metrics(zimg, ztxt, mesh=mesh, ks=(1, 5))
+    print({k: round(float(v), 4) for k, v in rm.items()}, file=sys.stderr)
+
     if args.ckpt_dir:
         save_checkpoint(args.ckpt_dir, jax.device_get(state))
         print(f"saved checkpoint to {args.ckpt_dir}", file=sys.stderr)
