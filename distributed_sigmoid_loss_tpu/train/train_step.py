@@ -116,11 +116,19 @@ def make_train_step(
     model: nn.Module,
     mesh: Mesh,
     loss_cfg: LossConfig = LossConfig(),
+    accum_steps: int = 1,
 ):
     """Build the jitted ``(state, batch) -> (state, metrics)`` step.
 
     ``batch`` is a dict of global arrays ``images`` (b, H, W, 3) and ``tokens``
     (b, L) sharded over the ``dp`` mesh axis.
+
+    ``accum_steps > 1`` splits the batch into that many microbatches, runs them
+    through a ``lax.scan``, and applies the averaged gradients once — the way to
+    reach e.g. the 32k-global north star on fewer chips. Contrastive caveat
+    (inherent to accumulation, same as open_clip without its re-encoding trick):
+    each microbatch contrasts only against its own texts, so the negative set per
+    loss term is ``global/accum_steps``, not ``global``.
     """
     axis = loss_cfg.axis_name
     precision = _precision(loss_cfg.precision)
@@ -160,10 +168,58 @@ def make_train_step(
         loss = sharded_loss(zimg, ztxt, lp["t_prime"], lp["bias"])
         return loss, lp
 
+    def grads_and_metrics(params, batch):
+        if accum_steps == 1:
+            (loss, lp), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            return loss, lp, grads
+
+        d = mesh.shape[axis]
+
+        def split(x):
+            # (B, ...) -> (accum, B/accum, ...) INTERLEAVED per shard: microbatch
+            # i takes the i-th chunk of every device's resident rows, so the
+            # reshuffle is layout-only — a contiguous global split would all-to-all
+            # the raw batch across devices every step. Microbatch composition is
+            # arbitrary for training, so this is semantically free.
+            if x.shape[0] % (d * accum_steps):
+                raise ValueError(
+                    f"global batch {x.shape[0]} must divide by mesh "
+                    f"{axis}={d} x accum_steps={accum_steps}"
+                )
+            c = x.shape[0] // (d * accum_steps)
+            y = x.reshape(d, accum_steps, c, *x.shape[1:])
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh, P(axis))
+            )
+            y = jnp.swapaxes(y, 0, 1)
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh, P(None, axis))
+            )
+            y = y.reshape(accum_steps, d * c, *x.shape[1:])
+            return jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh, P(None, axis))
+            )
+
+        micro = jax.tree.map(split, batch)
+
+        def body(carry, mb):
+            loss_sum, grad_sum = carry
+            (loss, lp), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb
+            )
+            carry = (loss_sum + loss, jax.tree.map(jnp.add, grad_sum, grads))
+            return carry, lp
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss_sum, grad_sum), lps = lax.scan(body, (jnp.zeros(()), zeros), micro)
+        lp = jax.tree.map(lambda x: x[-1], lps)
+        grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
+        return loss_sum / accum_steps, lp, grads
+
     def step(state: TrainState, batch: dict):
-        (loss, lp), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch
-        )
+        loss, lp, grads = grads_and_metrics(state.params, batch)
         state = state.apply_gradients(grads=grads)
         metrics = {
             "loss": loss,

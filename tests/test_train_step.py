@@ -104,3 +104,93 @@ def test_train_matches_single_device_reference():
         results[1][1],
         results[4][1],
     )
+
+
+def test_grad_accumulation_matches_mean_of_microbatch_grads():
+    """accum_steps=2 with sgd(1.0) must land exactly at params - mean(microbatch
+    grads): the update itself proves the gradient averaging, not just the loss."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+    from distributed_sigmoid_loss_tpu.train import create_train_state, make_train_step
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig, SigLIPConfig
+
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    d = 4
+    mesh = make_mesh(d)
+    tx = optax.sgd(1.0)  # update = params - grads, so params expose the grads
+    rng = np.random.default_rng(0)
+    B, accum = 16, 2
+    batch = {
+        "images": jnp.asarray(rng.standard_normal((B, 16, 16, 3)), jnp.float32),
+        "tokens": jnp.asarray(rng.integers(0, 64, (B, 8)), jnp.int32),
+    }
+    state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+
+    lc = LossConfig(variant="ring")
+    step1, shardings = make_train_step(model, mesh, lc, accum_steps=1)
+    step2, _ = make_train_step(model, mesh, lc, accum_steps=accum)
+    batch = jax.device_put(batch, shardings)
+
+    # The accumulation split is interleaved per shard: microbatch i is the i-th
+    # chunk of every device's rows. Reproduce those index sets on the host.
+    idx = np.arange(B).reshape(d, accum, B // (d * accum)).swapaxes(0, 1).reshape(accum, -1)
+    copy = lambda s_: jax.tree.map(jnp.copy, s_)
+    micro_states, micro_losses = [], []
+    for i in range(accum):
+        mb = jax.tree.map(lambda x: x[idx[i]], batch)
+        st, m = step1(copy(state), mb)
+        micro_states.append(st)
+        micro_losses.append(float(m["loss"]))
+
+    state_acc, m_acc = step2(copy(state), batch)
+
+    np.testing.assert_allclose(
+        float(m_acc["loss"]), np.mean(micro_losses), rtol=1e-5
+    )
+    # sgd(1.0): params_i = params - g_i, so mean(params_i) = params - mean(g_i),
+    # which must equal the accumulated step's params exactly.
+    expected = jax.tree.map(
+        lambda a, b: (a + b) / 2, micro_states[0].params, micro_states[1].params
+    )
+    for e, g in zip(jax.tree.leaves(expected), jax.tree.leaves(state_acc.params)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e), rtol=2e-5, atol=1e-6)
+
+
+def test_grad_accumulation_rejects_indivisible_batch():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import (
+        LossConfig,
+        SigLIPConfig,
+        TrainConfig,
+    )
+
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    mesh = make_mesh(4)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": jnp.asarray(rng.standard_normal((16, 16, 16, 3)), jnp.float32),
+        "tokens": jnp.asarray(rng.integers(0, 64, (16, 8)), jnp.int32),
+    }
+    state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+    step3, shardings = make_train_step(model, mesh, LossConfig(variant="ring"), accum_steps=3)
+    with pytest.raises(ValueError, match="accum_steps"):
+        step3(state, jax.device_put(batch, shardings))
