@@ -26,6 +26,7 @@ def main():
     # accumulation on v5e-64).
     per_chip_batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    model_name = sys.argv[3] if len(sys.argv) > 3 else "b16"  # b16 | l14
 
     import jax
     import jax.numpy as jnp
@@ -52,10 +53,17 @@ def main():
     mesh = make_mesh(n_dev)
     from distributed_sigmoid_loss_tpu.utils.config import TextConfig, ViTConfig
 
-    cfg = SigLIPConfig(
-        vision=ViTConfig(remat_policy="save_hot"),
-        text=TextConfig(remat_policy="save_hot"),
-    )
+    if model_name == "l14":
+        # L/14 needs full remat at useful batch sizes (save_hot exceeds v5e HBM).
+        cfg = SigLIPConfig(
+            vision=ViTConfig.vit_l14(),
+            text=TextConfig(width=1024, num_heads=16),
+        )
+    else:
+        cfg = SigLIPConfig(
+            vision=ViTConfig(remat_policy="save_hot"),
+            text=TextConfig(remat_policy="save_hot"),
+        )
     model = SigLIP(cfg)
     tx = make_optimizer(TrainConfig(warmup_steps=100, total_steps=100_000))
 
@@ -104,7 +112,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "siglip_vitb16_train_pairs_per_sec_per_chip",
+                "metric": f"siglip_vit{model_name}_train_pairs_per_sec_per_chip",
                 "value": round(pairs_per_sec_per_chip, 2),
                 "unit": "pairs/s/chip",
                 "vs_baseline": round(pairs_per_sec_per_chip / A100_REF_PAIRS_PER_SEC, 3),
