@@ -54,7 +54,9 @@ def _fwd_kernel(tp_ref, bias_ref, off_ref, zimg_ref, ztxt_ref, out_ref):
 
     @pl.when(j == 0)
     def _():
-        out_ref[0, 0] = 0.0
+        # Full-ref (1, 1) stores: element-wise scalar stores to VMEM are interpret-
+        # mode-only; Mosaic rejects them on hardware.
+        out_ref[...] = jnp.zeros_like(out_ref)
 
     b, tile_n = zimg_ref.shape[0], ztxt_ref.shape[0]
     t = jnp.exp(tp_ref[0])
@@ -69,7 +71,7 @@ def _fwd_kernel(tp_ref, bias_ref, off_ref, zimg_ref, ztxt_ref, out_ref):
     cols = lax.broadcasted_iota(jnp.int32, (b, tile_n), 1) + j * tile_n
     labels = jnp.where(cols == rows + jnp.int32(off_ref[0]), 1.0, -1.0)
     # -log_sigmoid(x) == softplus(-x)
-    out_ref[0, 0] += jnp.sum(jax.nn.softplus(-labels * logits))
+    out_ref[...] = out_ref[...] + jnp.sum(jax.nn.softplus(-labels * logits))
 
 
 def _bwd_kernel(
@@ -82,8 +84,8 @@ def _bwd_kernel(
     @pl.when(j == 0)
     def _():
         dzimg_ref[:] = jnp.zeros_like(dzimg_ref)
-        dtp_ref[0, 0] = 0.0
-        dbias_ref[0, 0] = 0.0
+        dtp_ref[...] = jnp.zeros_like(dtp_ref)
+        dbias_ref[...] = jnp.zeros_like(dbias_ref)
 
     b, tile_n = zimg_ref.shape[0], ztxt_ref.shape[0]
     t = jnp.exp(tp_ref[0])
@@ -113,8 +115,8 @@ def _bwd_kernel(
         )
         * t
     )
-    dtp_ref[0, 0] += jnp.sum(dlogits * raw) * t
-    dbias_ref[0, 0] += jnp.sum(dlogits)
+    dtp_ref[...] = dtp_ref[...] + jnp.sum(dlogits * raw) * t
+    dbias_ref[...] = dbias_ref[...] + jnp.sum(dlogits)
 
 
 def _scalar_spec():
