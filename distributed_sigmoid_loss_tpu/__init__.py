@@ -18,11 +18,16 @@ Public surface (mirrors the reference component inventory, see SURVEY.md §2):
 - :mod:`.parallel.ring_attention` — sequence-parallel exact attention over the same
   ppermute ring topology (long-context path).
 - :mod:`.ops.pallas_sigmoid_loss` — fused Pallas TPU kernel for the loss hot op.
+- :mod:`.ops.pallas_short_attention` / :mod:`.ops.flash_attention` — fused attention
+  kernels for the towers (VMEM-resident short-sequence kernel; blockwise flash for
+  long context).
 - :mod:`.models` — toy linear towers (reference test harness) plus real ViT + text
   transformer towers for the SigLIP training target.
-- :mod:`.train` — pjit train step, optax optimizer wiring, orbax checkpointing.
-- :mod:`.data` / :mod:`.utils` — synthetic data pipeline, configs, parity-data recipe,
-  metrics logging, profiling.
+- :mod:`.train` — pjit train step (with gradient accumulation), optax optimizer
+  wiring, orbax checkpointing.
+- :mod:`.eval` — zero-shot retrieval recall@K, sharded over the mesh.
+- :mod:`.data` / :mod:`.utils` — synthetic data + input pipeline (multi-host global
+  batches, prefetch), configs, parity-data recipe, metrics logging, profiling.
 """
 
 __version__ = "0.1.0"

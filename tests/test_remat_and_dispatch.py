@@ -1,0 +1,72 @@
+"""Remat-policy gradient equivalence and attention dispatch guards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.models import SigLIP
+from distributed_sigmoid_loss_tpu.models.transformer import Attention
+from distributed_sigmoid_loss_tpu.utils.config import (
+    SigLIPConfig,
+    TextConfig,
+    ViTConfig,
+)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _grads(remat_policy):
+    """fwd+bwd of a tiny SigLIP with remat on and the given policy (cached: the
+    full-remat reference is shared across the parametrized cases)."""
+    cfg = SigLIPConfig(
+        vision=ViTConfig(
+            image_size=16, patch_size=8, width=32, depth=2, num_heads=2,
+            embed_dim=16, dtype="float32", remat=True, scan_layers=True,
+            remat_policy=remat_policy,
+        ),
+        text=TextConfig(
+            vocab_size=64, context_length=8, width=32, depth=2, num_heads=2,
+            embed_dim=16, dtype="float32", remat=True, scan_layers=True,
+            remat_policy=remat_policy,
+        ),
+    )
+    model = SigLIP(cfg)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((4, 16, 16, 3)), jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, 64, (4, 8)), jnp.int32)
+    params = model.init(jax.random.key(0), images, tokens)["params"]
+    import flax.linen as nn
+
+    params = nn.meta.unbox(params)
+
+    def loss(p):
+        zimg, ztxt, lp = model.apply({"params": p}, images, tokens)
+        return jnp.sum(zimg * ztxt) + lp["t_prime"] * 0
+
+    return jax.grad(loss)(params)
+
+
+@pytest.mark.parametrize("policy", ["save_hot", "save_all_hot", "save_mlp"])
+def test_remat_policy_grads_equal_full_remat(policy):
+    """Checkpoint policies change WHAT is recomputed, never the math: gradients
+    must match full remat to fp32 round-off."""
+    ref = _grads("nothing")
+    got = _grads(policy)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)
+
+
+def test_unknown_remat_policy_raises():
+    with pytest.raises(ValueError, match="remat_policy"):
+        _grads("bogus")
+
+
+def test_flash_cross_attention_raises():
+    attn = Attention(width=32, num_heads=2, dtype=jnp.float32, attn_impl="flash")
+    xq = jnp.zeros((2, 1, 32))
+    xkv = jnp.zeros((2, 8, 32))
+    with pytest.raises(ValueError, match="self-attention"):
+        attn.init(jax.random.key(0), xq, xkv)
