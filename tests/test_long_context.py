@@ -59,3 +59,49 @@ def test_sequence_parallel_grads_flow():
         )(params)
     norms = [float(jnp.linalg.norm(x)) for x in jax.tree.leaves(g)]
     assert all(np.isfinite(norms)) and max(norms) > 0
+
+
+def test_train_step_with_sequence_parallel_text_tower():
+    """Full train step on a (dp × sp) mesh: batch sharded over dp, the text
+    tower's attention sequence-parallel over sp, contrastive loss over dp — the
+    long-context training composition, end to end."""
+    import optax
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from jax.sharding import Mesh
+    from distributed_sigmoid_loss_tpu.train import create_train_state, make_train_step
+    from distributed_sigmoid_loss_tpu.utils.config import (
+        LossConfig,
+        SigLIPConfig,
+        TextConfig,
+        ViTConfig,
+    )
+
+    cfg = SigLIPConfig(
+        vision=ViTConfig.tiny_test(),
+        text=TextConfig(
+            vocab_size=64, context_length=16, width=32, depth=2, num_heads=2,
+            embed_dim=16, dtype="float32", remat=False, scan_layers=False,
+            sequence_parallel_axis="sp",
+        ),
+    )
+    model = SigLIP(cfg)
+    # Size-1 tp axis: the tower kernels carry tp partitioning metadata, which an
+    # ambient mesh must be able to resolve.
+    devices = np.asarray(jax.devices()[:8]).reshape(2, 4, 1)
+    mesh = Mesh(devices, ("dp", "sp", "tp"))
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": jnp.asarray(rng.standard_normal((8, 16, 16, 3)), jnp.float32),
+        "tokens": jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32),
+    }
+    with jax.set_mesh(mesh):
+        state = create_train_state(jax.random.key(0), model, optax.adam(1e-3), batch, mesh)
+        step, shardings = make_train_step(model, mesh, LossConfig(variant="ring"))
+        batch = jax.device_put(batch, shardings)
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
