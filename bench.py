@@ -4,29 +4,77 @@
 Runs the full flagship train step — ViT-B/16 + text transformer + ring sigmoid loss +
 adamw update — on the real TPU chip at the measured single-chip sweet spot (256
 pairs/chip with the save_hot remat policy; the 32768-global north star maps to a
-v5e-128 or two grad-accumulation steps on v5e-64) and prints ONE JSON line.
+v5e-128 or grad-accumulation steps on smaller slices, see --accum) and prints ONE JSON
+line with throughput, achieved TFLOP/s, and MFU.
 
 The reference publishes no benchmark numbers (BASELINE.md); the ``vs_baseline`` ratio is
 measured throughput vs the A100 ballpark for open_clip-style ViT-B/16 contrastive
 training (~1100 pairs/sec/GPU, bf16) — the north-star gate is vs_baseline >= 1.5.
+
+Usage: bench.py [batch [steps [model]]] [--use-pallas] [--accum N] [--variant V]
+Positional args keep the historical invocation; config is echoed in the JSON so runs
+across revisions are comparable.
 """
 
+import argparse
 import json
-import sys
 import time
 
 A100_REF_PAIRS_PER_SEC = 1100.0  # open_clip ViT-B/16 A100 bf16 ballpark (no published ref)
 
+# Peak dense bf16 TFLOP/s by TPU generation (public spec sheets), for the MFU figure.
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def transformer_forward_flops(s: int, width: int, depth: int, mlp_ratio: int) -> float:
+    """Analytic forward FLOPs for one sequence through a standard pre-LN transformer:
+    per layer 24·s·w² (qkv/out/mlp matmul MACs×2 at mlp_ratio 4) + 4·s²·w (attention
+    scores + values). Elementwise/LN omitted (<1%)."""
+    per_layer = (4 + 4 + 4 * mlp_ratio) * s * width * width + 4 * s * s * width
+    return float(depth * per_layer)
+
+
+def model_forward_flops_per_pair(cfg) -> float:
+    """Forward FLOPs for ONE image-text pair through the SigLIP towers (loss matmul
+    excluded — it depends on the negative-set size and is <1% at bench shapes)."""
+    v, t = cfg.vision, cfg.text
+    s_img = (v.image_size // v.patch_size) ** 2
+    vit = transformer_forward_flops(s_img, v.width, v.depth, v.mlp_ratio)
+    # Patch embedding: s · (p²·3·w) MACs ×2; MAP pool ≈ k/v projections over s tokens.
+    vit += 2.0 * s_img * v.patch_size * v.patch_size * 3 * v.width
+    if v.pool == "map":
+        vit += 4.0 * s_img * v.width * v.width
+    vit += 2.0 * v.width * v.embed_dim
+    txt = transformer_forward_flops(t.context_length, t.width, t.depth, t.mlp_ratio)
+    txt += 2.0 * t.width * t.embed_dim
+    return vit + txt
+
 
 def main():
+    ap = argparse.ArgumentParser(description=__doc__)
     # 256/chip with the save_hot remat policy is the measured single-chip sweet
-    # spot (726 pairs/s vs 664 at 512 with full remat): selective checkpointing
+    # spot (727 pairs/s vs 664 at 512 with full remat): selective checkpointing
     # cuts backward recompute to ~25% of forward and 256/chip still fills the MXU.
-    # The 32768-global north star then maps to a v5e-128 (or 2 steps of grad
-    # accumulation on v5e-64).
-    per_chip_batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
-    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
-    model_name = sys.argv[3] if len(sys.argv) > 3 else "b16"  # b16 | l14
+    ap.add_argument("batch", nargs="?", type=int, default=256,
+                    help="per-chip pairs per optimizer step (before accumulation)")
+    ap.add_argument("steps", nargs="?", type=int, default=10)
+    ap.add_argument("model", nargs="?", default="b16", choices=["b16", "l14", "tiny"])
+    ap.add_argument("--use-pallas", action="store_true",
+                    help="fused Pallas loss kernel instead of the XLA-fused path")
+    ap.add_argument("--accum", type=int, default=1,
+                    help="gradient-accumulation microsteps (scan over microbatches); "
+                         "batch is the TOTAL per-chip pairs per optimizer step")
+    ap.add_argument("--variant", default="ring", choices=["ring", "all_gather"])
+    ap.add_argument("--precision", default="default", choices=["default", "highest"])
+    args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
@@ -46,19 +94,22 @@ def main():
     from distributed_sigmoid_loss_tpu.utils.config import (
         LossConfig,
         SigLIPConfig,
+        TextConfig,
         TrainConfig,
+        ViTConfig,
     )
 
     n_dev = len(jax.devices())
     mesh = make_mesh(n_dev)
-    from distributed_sigmoid_loss_tpu.utils.config import TextConfig, ViTConfig
 
-    if model_name == "l14":
+    if args.model == "l14":
         # L/14 needs full remat at useful batch sizes (save_hot exceeds v5e HBM).
         cfg = SigLIPConfig(
             vision=ViTConfig.vit_l14(),
             text=TextConfig(width=1024, num_heads=16),
         )
+    elif args.model == "tiny":
+        cfg = SigLIPConfig.tiny_test()  # harness smoke config (CPU-runnable)
     else:
         cfg = SigLIPConfig(
             vision=ViTConfig(remat_policy="save_hot"),
@@ -67,7 +118,7 @@ def main():
     model = SigLIP(cfg)
     tx = make_optimizer(TrainConfig(warmup_steps=100, total_steps=100_000))
 
-    global_b = per_chip_batch * n_dev
+    global_b = args.batch * n_dev
 
     # Generate the batch ON the device: the tunneled chip makes host->device transfer
     # of hundreds of MB the bottleneck, and the metric is step compute, not host IO.
@@ -87,38 +138,78 @@ def main():
     batch = make_batch(jax.random.key(0))
 
     state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
-    # Throughput path: ring variant, bf16 matmuls in the loss.
+    loss_cfg = LossConfig(
+        variant=args.variant, precision=args.precision, use_pallas=args.use_pallas
+    )
     step, shardings = make_train_step(
-        model, mesh, LossConfig(variant="ring", precision="default")
+        model, mesh, loss_cfg, accum_steps=args.accum
     )
     batch = jax.device_put(batch, shardings)
+
+    # AOT-compile once and reuse the executable for warmup + the timed loop (a
+    # second trace-and-compile via the jit cache would double the multi-minute
+    # XLA compile on the tunneled chip). cost_analysis() reports the FLOPs of the
+    # post-SPMD-partitioning PER-DEVICE module (includes remat recompute); it may
+    # be unavailable on some PJRT backends.
+    compiled = step.lower(state, batch).compile()
+    hw_flops_per_step_per_dev = None
+    try:
+        cost = compiled.cost_analysis()
+        if cost and cost.get("flops", 0) > 0:
+            hw_flops_per_step_per_dev = float(cost["flops"])
+    except Exception:
+        pass
 
     # Warmup (compile + first steps). Sync via device->host transfer: on the axon
     # tunnel ``jax.block_until_ready`` returns before execution finishes (measured:
     # 10 full ViT-B/16 steps "complete" in 7ms), while a float() transfer genuinely
     # drains the queue.
     for _ in range(3):
-        state, metrics = step(state, batch)
+        state, metrics = compiled(state, batch)
     float(metrics["loss"])
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch)
+    for _ in range(args.steps):
+        state, metrics = compiled(state, batch)
     final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
     assert jnp.isfinite(final_loss), f"non-finite loss in bench: {final_loss}"
 
-    pairs_per_sec_per_chip = global_b * steps / dt / n_dev
-    print(
-        json.dumps(
-            {
-                "metric": f"siglip_vit{model_name}_train_pairs_per_sec_per_chip",
-                "value": round(pairs_per_sec_per_chip, 2),
-                "unit": "pairs/s/chip",
-                "vs_baseline": round(pairs_per_sec_per_chip / A100_REF_PAIRS_PER_SEC, 3),
-            }
-        )
-    )
+    pairs_per_sec_per_chip = global_b * args.steps / dt / n_dev
+
+    # MFU on the standard model-FLOPs basis (3x forward: fwd + 2x bwd, remat
+    # recompute excluded); hw_util additionally counts executed recompute FLOPs.
+    device_kind = jax.devices()[0].device_kind
+    peak = PEAK_BF16_TFLOPS.get(device_kind)
+    model_flops_per_pair = 3.0 * model_forward_flops_per_pair(cfg)
+    achieved_model_tflops = model_flops_per_pair * pairs_per_sec_per_chip / 1e12
+    record = {
+        "metric": f"siglip_vit{args.model}_train_pairs_per_sec_per_chip",
+        "value": round(pairs_per_sec_per_chip, 2),
+        "unit": "pairs/s/chip",
+        "vs_baseline": round(pairs_per_sec_per_chip / A100_REF_PAIRS_PER_SEC, 3),
+        "model": args.model,
+        "per_chip_batch": args.batch,
+        "global_batch": global_b,
+        "accum_steps": args.accum,
+        "steps": args.steps,
+        "variant": args.variant,
+        "precision": args.precision,
+        "use_pallas": args.use_pallas,
+        "remat_policy": cfg.vision.remat_policy,
+        "n_devices": n_dev,
+        "device_kind": device_kind,
+        "final_loss": round(final_loss, 4),
+        "model_tflops_per_sec_per_chip": round(achieved_model_tflops, 1),
+    }
+    if hw_flops_per_step_per_dev is not None:
+        hw_tflops = hw_flops_per_step_per_dev * args.steps / dt / 1e12
+        record["hw_tflops_per_sec_per_chip"] = round(hw_tflops, 1)
+    if peak is not None:
+        record["mfu"] = round(achieved_model_tflops / peak, 3)
+        if hw_flops_per_step is not None:
+            record["hw_util"] = round(hw_tflops / peak, 3)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
