@@ -55,11 +55,12 @@ def _sharded_ranks(zimg, ztxt, axis_name):
     return jnp.sum(sims > pos[:, None, None], axis=(1, 2))
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=8)
 def _sharded_ranks_fn(mesh: Mesh, axis_name: str):
     """Cached so repeated evals reuse the compiled executable (jit caches by
     function object identity — rebuilding the shard_map each call would recompile
-    every time)."""
+    every time). Bounded LRU: an eval loop that rebuilds meshes evicts stale
+    entries (and their pinned executables) instead of growing for process life."""
     return jax.jit(
         jax.shard_map(
             partial(_sharded_ranks, axis_name=axis_name),

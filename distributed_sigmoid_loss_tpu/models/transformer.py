@@ -159,7 +159,7 @@ class Attention(nn.Module):
                 flash_self_attention,
             )
             from distributed_sigmoid_loss_tpu.ops.pallas_short_attention import (
-                SHORT_ATTENTION_MAX_SEQ,
+                short_attention_fits,
                 short_self_attention,
             )
             from distributed_sigmoid_loss_tpu.parallel.ring_attention import (
@@ -169,13 +169,19 @@ class Attention(nn.Module):
             # "auto" picks a fused Pallas kernel only for bf16 self-attention: the
             # fused backward matmuls are bf16-grade, which is exactly right for
             # bf16 training but would silently degrade an f32 parity run. Short
-            # sequences (towers) take the VMEM-resident kernel; long ones the
-            # blockwise flash kernel.
+            # sequences (towers) take the VMEM-resident kernel when its per-program
+            # footprint fits the VMEM budget; otherwise the blockwise flash kernel.
             if self.attn_impl == "flash" and not is_self_attention:
                 raise ValueError(
                     "attn_impl='flash' requires self-attention (the fused kernels "
                     "assume q/k/v share one sequence); use 'auto' or 'dense' for "
                     "cross-attention"
+                )
+            if self.attn_impl == "flash" and not flash_attention_available():
+                raise ValueError(
+                    "attn_impl='flash' requires a TPU backend (current: "
+                    f"{jax.default_backend()!r}); use 'auto' to fall back to the "
+                    "dense path automatically"
                 )
             use_fused = self.attn_impl == "flash" or (
                 self.attn_impl == "auto"
@@ -183,7 +189,9 @@ class Attention(nn.Module):
                 and self.dtype == jnp.bfloat16
                 and flash_attention_available()
             )
-            if use_fused and q.shape[1] <= SHORT_ATTENTION_MAX_SEQ:
+            if use_fused and short_attention_fits(
+                q.shape[1], self.width, jnp.dtype(self.dtype).itemsize
+            ):
                 out = short_self_attention(q, k, v, self.causal)
             elif use_fused:
                 out = flash_self_attention(q, k, v, causal=self.causal)

@@ -18,8 +18,6 @@ is TPU-first engineering for the BASELINE.json end-to-end throughput target.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -39,12 +37,49 @@ def _pad_len(s: int) -> int:
     return (s + _SEQ_MULTIPLE - 1) // _SEQ_MULTIPLE * _SEQ_MULTIPLE
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale"))
-def flash_self_attention(q, k, v, *, causal: bool = False, scale: float | None = None):
+def _block_size(s_pad: int) -> int:
+    """Largest power-of-two block ≤512 dividing the padded length — the kernel
+    requires divisibility in BOTH grid directions (backward also blocks q)."""
+    return next(b for b in (512, 256, 128) if s_pad % b == 0)
+
+
+def _prepare_inputs(q, k, v):
+    """Transpose to the kernel's (b, h, s, dh) layout, zero-pad the sequence to a
+    block multiple, and build pad-masking segment ids.
+
+    Returns ``(qt, kt, vt, segment_id_rows, s_pad)`` where ``segment_id_rows`` is the
+    per-position (b, s_pad) int32 id array (1 = real token, 0 = padding) or ``None``
+    when no padding was needed. Real queries never attend padding (different segment);
+    padded query rows attend only padding (finite softmax) and are sliced off after
+    the kernel.
+    """
+    b, s, h, dh = q.shape
+    s_pad = _pad_len(s)
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
+    ids = None
+    if s_pad != s:
+        pad = ((0, 0), (0, 0), (0, s_pad - s), (0, 0))
+        qt, kt, vt = (jnp.pad(t, pad) for t in (qt, kt, vt))
+        ids = (jnp.arange(s_pad, dtype=jnp.int32) < s).astype(jnp.int32)
+        ids = jnp.broadcast_to(ids[None], (b, s_pad))
+    return qt, kt, vt, ids, s_pad
+
+
+def flash_self_attention(
+    q, k, v, *, causal: bool = False, scale: float | None = None, kernel_fn=None
+):
     """Drop-in replacement for ``dense_attention``: (b, s, h, dh) → (b, s, h, dh).
 
     Self-attention only (q/k/v share a sequence length). Numerics match the dense
     path (f32 online softmax) up to flash's blockwise summation order.
+
+    ``kernel_fn(qt, kt, vt, segment_ids, causal, sm_scale, block_sizes)`` overrides
+    the Pallas kernel — used by CPU tests to verify the padding/masking/slicing
+    plumbing with a dense stand-in kernel.
     """
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes,
@@ -53,29 +88,11 @@ def flash_self_attention(q, k, v, *, causal: bool = False, scale: float | None =
     )
 
     b, s, h, dh = q.shape
-    scale = (dh**-0.5) if scale is None else scale
-    s_pad = _pad_len(s)
+    sm_scale = (dh**-0.5) if scale is None else scale
+    qt, kt, vt, ids, s_pad = _prepare_inputs(q, k, v)
+    segment_ids = SegmentIds(q=ids, kv=ids) if ids is not None else None
 
-    # Kernel layout is (b, h, s, dh).
-    qt = jnp.transpose(q, (0, 2, 1, 3))
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-
-    segment_ids = None
-    if s_pad != s:
-        pad = ((0, 0), (0, 0), (0, s_pad - s), (0, 0))
-        qt, kt, vt = (jnp.pad(t, pad) for t in (qt, kt, vt))
-        # Real tokens get segment id 1, padding id 0: real queries never attend
-        # padding; padded queries attend only padding (finite softmax, rows are
-        # sliced off below).
-        ids = (jnp.arange(s_pad, dtype=jnp.int32) < s).astype(jnp.int32)
-        ids = jnp.broadcast_to(ids[None], (b, s_pad))
-        segment_ids = SegmentIds(q=ids, kv=ids)
-
-    # The kernel requires the sequence length to be divisible by the block size
-    # (both directions — backward also blocks the q dim), so pick the largest
-    # power-of-two block ≤512 that divides the padded length.
-    block = next(b for b in (512, 256, 128) if s_pad % b == 0)
+    block = _block_size(s_pad)
     block_sizes = BlockSizes(
         block_q=block,
         block_k_major=block,
@@ -89,13 +106,14 @@ def flash_self_attention(q, k, v, *, causal: bool = False, scale: float | None =
         block_k_dq=block,
         block_q_dq=block,
     )
-    out = flash_attention(
+    kernel = kernel_fn if kernel_fn is not None else flash_attention
+    out = kernel(
         qt,
         kt,
         vt,
         segment_ids=segment_ids,
         causal=causal,
-        sm_scale=scale,
+        sm_scale=sm_scale,
         block_sizes=block_sizes,
     )
     return jnp.transpose(out[:, :, :s, :], (0, 2, 1, 3))

@@ -32,13 +32,46 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["short_self_attention", "SHORT_ATTENTION_MAX_SEQ"]
+__all__ = [
+    "short_self_attention",
+    "short_attention_fits",
+    "short_attention_vmem_bytes",
+    "SHORT_ATTENTION_MAX_SEQ",
+]
 
 _NEG_INF = -1e30
 
 # Above this sequence length the O(s²) per-head blocks stop fitting VMEM comfortably
 # and a blockwise (true flash / ring) kernel wins; dispatch there instead.
 SHORT_ATTENTION_MAX_SEQ = 1024
+
+# TPU VMEM is ~16 MiB/core across v4/v5e/v5p; the budget leaves headroom for the
+# compiler's own scratch and pipelining buffers. A program over budget fails at
+# Mosaic compile time with no fallback, so the dispatcher must pre-check.
+_VMEM_BYTES = 16 * 1024 * 1024
+_VMEM_BUDGET_FRACTION = 0.7
+
+
+def short_attention_vmem_bytes(s: int, width: int, dtype_bytes: int) -> int:
+    """Worst-case VMEM footprint of ONE grid program (width = h·dh).
+
+    The backward program is the peak: 7 (s, width) I/O blocks (q, k, v, do, dq, dk,
+    dv) resident for the whole program, plus ~3 live (s, s) f32 per-head
+    intermediates (probs, dp, ds — the compiler can reuse across heads but not
+    within the chain).
+    """
+    return 7 * s * width * dtype_bytes + 3 * s * s * 4
+
+
+def short_attention_fits(s: int, width: int, dtype_bytes: int) -> bool:
+    """True when the fused short kernel's per-program footprint fits the VMEM
+    budget AND the sequence is within the design envelope. Callers fall back to
+    blockwise flash (TPU) or dense (elsewhere) when False."""
+    return (
+        s <= SHORT_ATTENTION_MAX_SEQ
+        and short_attention_vmem_bytes(s, width, dtype_bytes)
+        <= _VMEM_BYTES * _VMEM_BUDGET_FRACTION
+    )
 
 
 def _dot(a, b, contract_a: int, contract_b: int):

@@ -22,6 +22,33 @@ from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis, model_axis
 
 __all__ = ["initialize_multihost", "make_hybrid_mesh", "global_batch_for"]
 
+# Environment markers that a multi-host job context exists. When any is set, a failed
+# bring-up must NEVER degrade to single-process: every host runs this same code, so the
+# degradation would silently turn an N-host job into N independent trainings.
+_MULTIHOST_ENV_VARS = (
+    "COORDINATOR_ADDRESS",
+    "JAX_COORDINATOR_ADDRESS",
+    "JAX_COORDINATOR_IP",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",
+    "CLOUD_TPU_TASK_ID",
+)
+
+
+def _multihost_env_marker() -> str | None:
+    import os
+
+    for var in _MULTIHOST_ENV_VARS:
+        value = os.environ.get(var)
+        if not value:
+            continue
+        if var == "TPU_WORKER_HOSTNAMES" and "," not in value:
+            # A single hostname is a 1-host job (some TPU runtimes set this even
+            # for one host); only a multi-entry list implies peers exist.
+            continue
+        return var
+    return None
+
 
 def initialize_multihost(**kwargs) -> tuple[int, int]:
     """Bring up the multi-host runtime; returns ``(process_index, process_count)``.
@@ -32,32 +59,41 @@ def initialize_multihost(**kwargs) -> tuple[int, int]:
     """
     if kwargs:
         # Explicit coordinator config: let failures propagate — silently degrading to
-        # single-process would strand the other hosts at the rendezvous.
+        # single-process would strand the other hosts at the rendezvous, and a
+        # conflicting re-init on a live runtime must raise (jax enforces it), not
+        # silently keep the previous identity.
         jax.distributed.initialize(**kwargs)
+    elif jax.distributed.is_initialized():
+        # State check, not message matching: an argument-less call on a live runtime
+        # (e.g. a pod run invoking this helper from two entry points) is the benign
+        # no-op.
+        return jax.process_index(), jax.process_count()
     else:
         try:
             jax.distributed.initialize()
         except RuntimeError as e:
-            # Benign only when the runtime is already up or there is no distributed
-            # context to join. A transient coordinator failure must propagate —
-            # swallowing it would strand every other host at the rendezvous while
-            # this one trains alone.
+            # A transient coordinator failure must propagate — swallowing it would
+            # strand every other host at the rendezvous while this one trains alone.
+            # (The already-initialized case is handled by the state check above;
+            # message matching below covers only the no-distributed-context cases,
+            # each pinned by tests/test_multihost_process.py.)
             msg = str(e).lower()
             benign = (
-                # Already initialized (e.g. a properly brought-up pod run calling
-                # this helper a second time): the runtime is live, nothing to do.
-                "should only be called once" in msg
-                or "already initialized" in msg
-                or "already been initialized" in msg
-                # Backend started without a distributed client: only reachable
-                # single-process (a multi-process run that computed before
-                # initializing is indistinguishable here and will surface at the
-                # peers' rendezvous timeout instead).
-                or "must be called before" in msg
+                # Backend started without a distributed client: benign single-
+                # process, UNLESS a multi-host env marker says peers exist.
+                "must be called before" in msg
                 # No coordinator to auto-detect — plain single-process run.
                 or "unable to detect" in msg
                 or "could not detect" in msg
             )
+            if benign and (marker := _multihost_env_marker()):
+                raise RuntimeError(
+                    f"initialize_multihost: jax.distributed.initialize() failed "
+                    f"({e}) but {marker} is set, so this looks like one host of a "
+                    f"multi-host job. Refusing to degrade to single-process "
+                    f"training; call initialize_multihost() before any other jax "
+                    f"use, or pass coordinator_address/num_processes/process_id."
+                ) from e
             if not benign:
                 raise
         except ValueError as e:
@@ -67,6 +103,12 @@ def initialize_multihost(**kwargs) -> tuple[int, int]:
             # config — propagate rather than silently train alone.
             if "coordinator_address" not in str(e):
                 raise
+            if marker := _multihost_env_marker():
+                raise RuntimeError(
+                    f"initialize_multihost: nothing to auto-detect ({e}) but "
+                    f"{marker} is set — one host of a multi-host job would train "
+                    f"alone. Pass coordinator_address/num_processes/process_id."
+                ) from e
     return jax.process_index(), jax.process_count()
 
 

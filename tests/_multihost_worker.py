@@ -1,0 +1,103 @@
+"""Worker for the true 2-process multihost test (run via ``python -m`` or path).
+
+Each OS process is one "host" with 2 virtual CPU devices: rendezvous through
+``initialize_multihost`` at a localhost coordinator, build a mesh over the 4 GLOBAL
+devices, contribute its half of the global batch via ``global_batch_from_local``, run
+one sharded ring-loss value+grad, and print a JSON result line. This is the honest
+analogue of the reference's ``mp.spawn`` + Gloo harness
+(/root/reference/test_distributed_sigmoid_loss.py:125-130): real process boundaries,
+real cross-process collectives — not virtual devices in one process.
+
+Usage: _multihost_worker.py <process_id> <num_processes> <coordinator_port>
+"""
+
+import json
+import os
+import sys
+
+LOCAL_DEVICES = 2
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    port = int(sys.argv[3])
+
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={LOCAL_DEVICES}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from distributed_sigmoid_loss_tpu.parallel.multihost import initialize_multihost
+
+    try:
+        idx, cnt = initialize_multihost(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception as e:  # environmental: let the parent skip, not fail
+        print(f"INIT_FAILED: {type(e).__name__}: {e}", flush=True)
+        sys.exit(3)
+    assert (idx, cnt) == (process_id, num_processes), (idx, cnt)
+
+    # Second call on the live runtime must be a no-op (pins the state-check path).
+    idx2, cnt2 = initialize_multihost()
+    assert (idx2, cnt2) == (idx, cnt), "re-init on live runtime changed identity"
+
+    import jax.numpy as jnp
+
+    from distributed_sigmoid_loss_tpu.data.loader import global_batch_from_local
+    from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import init_loss_params
+    from distributed_sigmoid_loss_tpu.parallel.api import make_sharded_loss_fn
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+    n_global = len(jax.devices())
+    assert n_global == num_processes * LOCAL_DEVICES, n_global
+    assert len(jax.local_devices()) == LOCAL_DEVICES
+    mesh = make_mesh(n_global)
+
+    # Deterministic global batch; every host generates it all, contributes its rows
+    # (global_batch / process_count, in process order) — the reference's
+    # get_partition pattern (test_distributed_sigmoid_loss.py:57-68) in numpy.
+    B, D = 8, 16
+    rng = np.random.default_rng(1234)
+    zimg = rng.standard_normal((B, D)).astype(np.float32)
+    ztxt = rng.standard_normal((B, D)).astype(np.float32)
+    zimg /= np.linalg.norm(zimg, axis=-1, keepdims=True)
+    ztxt /= np.linalg.norm(ztxt, axis=-1, keepdims=True)
+
+    rows = B // num_processes
+    local = {
+        "zimg": zimg[process_id * rows : (process_id + 1) * rows],
+        "ztxt": ztxt[process_id * rows : (process_id + 1) * rows],
+    }
+    gbatch = global_batch_from_local(local, mesh)
+    assert gbatch["zimg"].shape == (B, D)
+
+    loss_fn = make_sharded_loss_fn(mesh, variant="ring")
+    params = init_loss_params()
+    loss, grads = jax.value_and_grad(
+        lambda p: loss_fn(p, gbatch["zimg"], gbatch["ztxt"])
+    )(params)
+
+    print(
+        json.dumps(
+            {
+                "process": process_id,
+                "n_global_devices": n_global,
+                "loss": float(loss),
+                "d_t_prime": float(grads["t_prime"]),
+                "d_bias": float(grads["bias"]),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

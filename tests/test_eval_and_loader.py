@@ -107,3 +107,25 @@ def test_sharded_metrics_fn_is_cached():
 
     mesh = make_mesh(8)
     assert _sharded_ranks_fn(mesh, "dp") is _sharded_ranks_fn(mesh, "dp")
+
+
+def test_sharded_metrics_cache_is_bounded():
+    """An eval loop that rebuilds meshes must not pin every compiled executable
+    for process life: the LRU evicts old entries."""
+    from distributed_sigmoid_loss_tpu.eval.retrieval import _sharded_ranks_fn
+
+    rng = np.random.default_rng(0)
+    z = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    z = z / jnp.linalg.norm(z, axis=-1, keepdims=True)
+    assert _sharded_ranks_fn.cache_info().maxsize == 8
+    for i in range(12):
+        # Distinct (mesh, axis_name) keys every iteration (equal meshes hash
+        # together, so vary the axis name to actually force eviction pressure).
+        mesh = make_mesh(2, axis_name=f"ax{i}")
+        retrieval_metrics(z, z, mesh=mesh, axis_name=f"ax{i}")
+    # The first key must have been EVICTED: looking it up again is a cache miss
+    # (equal mesh objects hash together, so this re-lookup would be a hit if the
+    # cache were unbounded).
+    misses_before = _sharded_ranks_fn.cache_info().misses
+    _sharded_ranks_fn(make_mesh(2, axis_name="ax0"), "ax0")
+    assert _sharded_ranks_fn.cache_info().misses == misses_before + 1

