@@ -7,3 +7,11 @@ from distributed_sigmoid_loss_tpu.train.checkpoint import (  # noqa: F401
     save_checkpoint,
     restore_checkpoint,
 )
+from distributed_sigmoid_loss_tpu.train.resilience import (  # noqa: F401
+    PreemptionGuard,
+    TrainingDiverged,
+    latest_step,
+    restore_latest,
+    save_step,
+    train_resilient,
+)

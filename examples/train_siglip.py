@@ -26,7 +26,11 @@ def main():
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--tiny", action="store_true", help="tiny model (CPU-friendly)")
     ap.add_argument("--cpu-devices", type=int, default=0, help="emulate N CPU devices")
-    ap.add_argument("--ckpt-dir", default="", help="save a checkpoint here at the end")
+    ap.add_argument("--ckpt-dir", default="",
+                    help="checkpoint/resume directory: resumes from the newest "
+                         "step-numbered checkpoint, saves every --ckpt-every steps "
+                         "and on SIGTERM (preemption)")
+    ap.add_argument("--ckpt-every", type=int, default=50)
     ap.add_argument("--log-every", type=int, default=1)
     args = ap.parse_args()
 
@@ -44,10 +48,11 @@ def main():
     from distributed_sigmoid_loss_tpu.models import SigLIP
     from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
     from distributed_sigmoid_loss_tpu.train import (
+        PreemptionGuard,
         create_train_state,
         make_optimizer,
         make_train_step,
-        save_checkpoint,
+        train_resilient,
     )
     from distributed_sigmoid_loss_tpu.utils.config import (
         LossConfig,
@@ -73,25 +78,59 @@ def main():
     )
 
     logger = MetricsLogger(every=args.log_every)
-    batch = jax.device_put(first, shardings)
-    for i in range(args.steps):
-        state, metrics = step_fn(state, batch)
-        logger.log(i, {k: float(v) for k, v in metrics.items()})
-        batch = jax.device_put(next(data), shardings)
+
+    def device_batches(skip: int = 0):
+        # The synthetic pipeline is deterministic per position: on resume, skip
+        # the batches the checkpointed steps already consumed so the resumed run
+        # sees the same stream an uninterrupted run would.
+        if skip == 0:
+            yield jax.device_put(first, shardings)
+        for i, b in enumerate(data, start=1):
+            if i >= skip:
+                yield jax.device_put(b, shardings)
+
+    if args.ckpt_dir:
+        # Preemption-safe resilient loop: resumes from the newest checkpoint in
+        # --ckpt-dir, saves every --ckpt-every steps and on SIGTERM, rolls back
+        # on a non-finite loss.
+        from distributed_sigmoid_loss_tpu.train import latest_step
+
+        skip = latest_step(args.ckpt_dir) or 0
+        with PreemptionGuard() as guard:
+            state, report = train_resilient(
+                state,
+                step_fn,
+                device_batches(skip),
+                total_steps=args.steps,
+                ckpt_dir=args.ckpt_dir,
+                ckpt_every=args.ckpt_every,
+                guard=guard,
+                on_metrics=lambda i, m: logger.log(
+                    i, {k: float(v) for k, v in m.items()}
+                ),
+            )
+        print(
+            f"resilient loop: steps {report.start_step}->{report.final_step}, "
+            f"checkpoints at {report.checkpoints}"
+            + (" (preempted)" if report.preempted else ""),
+            file=sys.stderr,
+        )
+    else:
+        # 1-based step numbers, matching train_resilient's on_metrics contract.
+        for i, batch in zip(range(1, args.steps + 1), device_batches()):
+            state, metrics = step_fn(state, batch)
+            logger.log(i, {k: float(v) for k, v in metrics.items()})
 
     # Zero-shot retrieval on a held-out synthetic batch (the model normalizes its
     # embeddings already).
     from distributed_sigmoid_loss_tpu.eval import retrieval_metrics
 
+    held_out = jax.device_put(next(iter(data)), shardings)
     zimg, ztxt, _ = model.apply(
-        {"params": state.params}, batch["images"], batch["tokens"]
+        {"params": state.params}, held_out["images"], held_out["tokens"]
     )
     rm = retrieval_metrics(zimg, ztxt, mesh=mesh, ks=(1, 5))
     print({k: round(float(v), 4) for k, v in rm.items()}, file=sys.stderr)
-
-    if args.ckpt_dir:
-        save_checkpoint(args.ckpt_dir, jax.device_get(state))
-        print(f"saved checkpoint to {args.ckpt_dir}", file=sys.stderr)
 
 
 if __name__ == "__main__":
