@@ -202,12 +202,20 @@ def main():
         "final_loss": round(final_loss, 4),
         "model_tflops_per_sec_per_chip": round(achieved_model_tflops, 1),
     }
+    # Executed-FLOPs utilization from XLA's cost model — only when self-consistent:
+    # executed FLOPs include remat recompute, so they can never be below the model
+    # FLOPs. Some PJRT plugins (observed: axon) report a module "flops" an order of
+    # magnitude low; publishing a 0.06 "hw_util" next to a 0.51 MFU would be noise.
+    hw_tflops = None
     if hw_flops_per_step_per_dev is not None:
         hw_tflops = hw_flops_per_step_per_dev * args.steps / dt / 1e12
-        record["hw_tflops_per_sec_per_chip"] = round(hw_tflops, 1)
+        if hw_tflops >= achieved_model_tflops:
+            record["hw_tflops_per_sec_per_chip"] = round(hw_tflops, 1)
+        else:
+            hw_tflops = None
     if peak is not None:
         record["mfu"] = round(achieved_model_tflops / peak, 3)
-        if hw_flops_per_step is not None:
+        if hw_tflops is not None:
             record["hw_util"] = round(hw_tflops / peak, 3)
     print(json.dumps(record))
 
