@@ -10,12 +10,19 @@ initializes, hence the env mutation at import time.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# DSL_TEST_TPU=1 skips the CPU forcing so the tpu-marked tests (flash-attention
+# kernel parity, real-MXU bf16 numerics) execute on a real chip:
+#   DSL_TEST_TPU=1 python -m pytest tests -q -m '' -k tpu
+# Multi-device tests will fail on a 1-chip platform — select the tpu tests only.
+_USE_REAL_TPU = os.environ.get("DSL_TEST_TPU") == "1"
+
+if not _USE_REAL_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 # Make the repo root importable regardless of how pytest was invoked.
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -26,5 +33,6 @@ import jax  # noqa: E402
 
 # The env var alone is not enough: the axon TPU plugin registers itself regardless, so
 # force the platform through the config API before the backend initializes.
-jax.config.update("jax_platforms", "cpu")
+if not _USE_REAL_TPU:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
