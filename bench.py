@@ -104,10 +104,7 @@ def main():
 
     if args.model == "l14":
         # L/14 needs full remat at useful batch sizes (save_hot exceeds v5e HBM).
-        cfg = SigLIPConfig(
-            vision=ViTConfig.vit_l14(),
-            text=TextConfig(width=1024, num_heads=16),
-        )
+        cfg = SigLIPConfig.l14()
     elif args.model == "tiny":
         cfg = SigLIPConfig.tiny_test()  # harness smoke config (CPU-runnable)
     else:

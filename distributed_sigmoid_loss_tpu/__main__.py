@@ -1,0 +1,5 @@
+import sys
+
+from distributed_sigmoid_loss_tpu.cli import main
+
+sys.exit(main())

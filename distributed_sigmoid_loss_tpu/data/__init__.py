@@ -8,3 +8,4 @@ from distributed_sigmoid_loss_tpu.data.synthetic import (  # noqa: F401
     SyntheticImageText,
     shard_batch,
 )
+from distributed_sigmoid_loss_tpu.data.tokenizer import ByteTokenizer  # noqa: F401

@@ -1,0 +1,72 @@
+"""Byte-level tokenizer — a self-contained text front end for the framework.
+
+The reference consumes pre-embedded text (its "texts" are random tensors,
+/root/reference/test_distributed_sigmoid_loss.py:57-64); a usable framework needs a
+string → token-ids front end for the text tower. Production SigLIP uses a 32k
+sentencepiece vocab; that requires a trained vocab artifact, so the built-in default
+is a dependency-free byte-level tokenizer (UTF-8 bytes + pad/bos/eos) with the same
+interface — deterministic, reversible, vocab small enough for every
+:class:`~distributed_sigmoid_loss_tpu.utils.config.TextConfig`. A sentencepiece/BPE
+vocab plugs in by implementing the same two methods (``__call__``/``decode``).
+
+TPU notes: output is a dense (batch, context_length) int32 array — static shape,
+pad-to-length — which is exactly what the jitted text tower wants; no ragged
+batching ever reaches the device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ByteTokenizer"]
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids = byte value + 3; 0/1/2 = pad/bos/eos."""
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    _offset = 3
+    vocab_size = 256 + _offset
+
+    def __init__(self, add_bos: bool = True, add_eos: bool = True):
+        self.add_bos = add_bos
+        self.add_eos = add_eos
+
+    def encode(self, text: str) -> list[int]:
+        """Token ids for one string, without padding/truncation."""
+        ids = [b + self._offset for b in text.encode("utf-8")]
+        if self.add_bos:
+            ids.insert(0, self.bos_id)
+        if self.add_eos:
+            ids.append(self.eos_id)
+        return ids
+
+    def decode(self, ids) -> str:
+        """Inverse of :meth:`encode`; pad/bos/eos are dropped. Truncation can split
+        a multi-byte UTF-8 character — invalid tails decode with replacement."""
+        data = bytes(
+            int(i) - self._offset for i in np.asarray(ids).reshape(-1)
+            if int(i) >= self._offset
+        )
+        return data.decode("utf-8", errors="replace")
+
+    def __call__(self, texts, context_length: int) -> np.ndarray:
+        """Batch-encode to a dense (len(texts), context_length) int32 array.
+
+        Sequences longer than ``context_length`` are truncated (keeping eos as the
+        final token when enabled, matching the usual CLIP/SigLIP convention);
+        shorter ones are right-padded with ``pad_id``.
+        """
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.full((len(texts), context_length), self.pad_id, np.int32)
+        for row, text in enumerate(texts):
+            ids = self.encode(text)
+            if len(ids) > context_length:
+                ids = ids[:context_length]
+                if self.add_eos:
+                    ids[-1] = self.eos_id
+            out[row, : len(ids)] = ids
+        return out

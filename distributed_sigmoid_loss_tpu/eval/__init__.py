@@ -3,5 +3,17 @@ from distributed_sigmoid_loss_tpu.eval.retrieval import (
     retrieval_metrics,
     retrieval_ranks,
 )
+from distributed_sigmoid_loss_tpu.eval.zeroshot import (
+    classifier_weights,
+    classify_ranks,
+    zeroshot_metrics,
+)
 
-__all__ = ["recall_at_k", "retrieval_metrics", "retrieval_ranks"]
+__all__ = [
+    "recall_at_k",
+    "retrieval_metrics",
+    "retrieval_ranks",
+    "classifier_weights",
+    "classify_ranks",
+    "zeroshot_metrics",
+]

@@ -1,0 +1,82 @@
+"""Zero-shot classification eval — the second standard SigLIP eval next to retrieval.
+
+The reference ships no eval (SURVEY.md §5). Zero-shot classification is how SigLIP-style
+models are actually scored (ImageNet top-1 in the paper): each class becomes a text
+embedding (averaged over prompt templates), and an image is classified by nearest class
+embedding. TPU-native design mirrors ``eval/retrieval.py``: image embeddings stay
+sharded over the ``dp`` mesh axis, the (n_classes, d) classifier matrix is replicated —
+one (b_local × n_classes) MXU matmul per shard, no collectives at all (each shard's
+top-k is independent), so the eval scales linearly in chips.
+
+Ranks are exact counts of strictly-greater logits, matching retrieval.py's tie
+convention (ties resolve optimistically).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import l2_normalize
+from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
+
+__all__ = ["classifier_weights", "classify_ranks", "zeroshot_metrics"]
+
+
+def classifier_weights(class_text_embeddings: jax.Array) -> jax.Array:
+    """(n_classes, n_templates, d) per-template text embeddings → (n_classes, d)
+    classifier: L2-normalize each template embedding, average over templates,
+    re-normalize (the CLIP/SigLIP prompt-ensembling recipe)."""
+    z = l2_normalize(class_text_embeddings)
+    return l2_normalize(jnp.mean(z, axis=1))
+
+
+def classify_ranks(zimg: jax.Array, classifier: jax.Array, labels: jax.Array) -> jax.Array:
+    """Rank (0-based) of each image's true class: the number of classes scoring
+    strictly higher than ``labels[i]`` for image ``i``. ``rank == 0`` ⇒ top-1 hit."""
+    logits = zimg @ classifier.T  # (b, n_classes)
+    # Read the true-class logit OUT of the matmul result (not recomputed
+    # elementwise): on TPU an MXU matmul and an elementwise recomputation differ
+    # at bf16 grade, which would let the true class outscore itself.
+    true_logit = jnp.take_along_axis(logits, labels[:, None], axis=1)
+    return jnp.sum(logits > true_logit, axis=1)
+
+
+@functools.lru_cache(maxsize=8)
+def _ranks_fn(mesh: Mesh, axis_name: str):
+    """Compiled sharded ranks: images/labels sharded over dp, classifier replicated.
+
+    No shard_map needed — every row's rank is independent, so a jit over sharded
+    inputs stays collective-free; XLA keeps the output sharded like the inputs.
+    Bounded LRU mirrors eval/retrieval.py (compiled executables are pinned per mesh).
+    """
+    data = NamedSharding(mesh, P(axis_name))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        classify_ranks,
+        in_shardings=(data, repl, data),
+        out_shardings=data,
+    )
+
+
+def zeroshot_metrics(
+    zimg: jax.Array,
+    classifier: jax.Array,
+    labels: jax.Array,
+    mesh: Mesh | None = None,
+    ks: tuple[int, ...] = (1, 5),
+    axis_name: str = data_axis,
+) -> dict[str, jax.Array]:
+    """Top-k zero-shot accuracy over the (global) image batch.
+
+    With a ``mesh``, ``zimg``/``labels`` are sharded over ``axis_name`` and the
+    classifier is replicated; without one, the plain single-device path runs.
+    """
+    if mesh is None:
+        ranks = classify_ranks(zimg, classifier, labels)
+    else:
+        ranks = _ranks_fn(mesh, axis_name)(zimg, classifier, labels)
+    return {f"top@{k}": jnp.mean(ranks < k) for k in ks}

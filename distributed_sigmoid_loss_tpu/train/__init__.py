@@ -1,6 +1,7 @@
 from distributed_sigmoid_loss_tpu.train.train_step import (  # noqa: F401
     make_optimizer,
     create_train_state,
+    init_params,
     make_train_step,
 )
 from distributed_sigmoid_loss_tpu.train.checkpoint import (  # noqa: F401

@@ -84,14 +84,12 @@ def param_shardings(mesh: Mesh, abstract_params) -> Any:
     )
 
 
-def create_train_state(
-    rng: jax.Array,
-    model: nn.Module,
-    tx: optax.GradientTransformation,
-    sample_batch: dict,
-    mesh: Mesh,
-) -> TrainState:
-    """Initialize params directly sharded onto the mesh (no host round-trip)."""
+def init_params(
+    rng: jax.Array, model: nn.Module, sample_batch: dict, mesh: Mesh
+) -> Any:
+    """Initialize model params directly sharded onto the mesh (no host
+    round-trip) — the forward-only half of :func:`create_train_state`, for eval
+    paths that never need optimizer slots."""
 
     def init_fn(rng):
         variables = model.init(rng, sample_batch["images"], sample_batch["tokens"])
@@ -101,9 +99,20 @@ def create_train_state(
     shardings = param_shardings(mesh, abstract)
     # Unbox the Partitioned metadata: shardings now carry the placement info.
     unboxed_shardings = nn.meta.unbox(shardings)
-    params = jax.jit(
+    return jax.jit(
         lambda r: nn.meta.unbox(init_fn(r)), out_shardings=unboxed_shardings
     )(rng)
+
+
+def create_train_state(
+    rng: jax.Array,
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    sample_batch: dict,
+    mesh: Mesh,
+) -> TrainState:
+    """Initialize a full train state, every leaf committed to the mesh."""
+    params = init_params(rng, model, sample_batch, mesh)
     # Build the optimizer state under jit too, so every leaf (adam moments follow the
     # param shardings, scalar counters replicate) is committed to the mesh — required
     # for sharding-stable checkpoint restore.

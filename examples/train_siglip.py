@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """End-to-end SigLIP training on synthetic data — the framework's "hello world".
 
-Ties together every subsystem: mesh, flagship towers, distributed sigmoid loss
-(all-gather or ring), optax, metrics logging, and orbax checkpointing.
+Thin wrapper over the package CLI (``python -m distributed_sigmoid_loss_tpu train``),
+kept for discoverability; the flag surface is the CLI's, and the training flow lives
+in ``distributed_sigmoid_loss_tpu/cli.py``.
 
 Usage (single real TPU chip):
     python examples/train_siglip.py --steps 20 --batch 64
@@ -11,127 +12,12 @@ CPU emulation of an 8-chip mesh:
     python examples/train_siglip.py --cpu-devices 8 --tiny --steps 10
 """
 
-import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--batch", type=int, default=64, help="global batch size")
-    ap.add_argument("--variant", choices=["all_gather", "ring"], default="ring")
-    ap.add_argument("--lr", type=float, default=1e-3)
-    ap.add_argument("--tiny", action="store_true", help="tiny model (CPU-friendly)")
-    ap.add_argument("--cpu-devices", type=int, default=0, help="emulate N CPU devices")
-    ap.add_argument("--ckpt-dir", default="",
-                    help="checkpoint/resume directory: resumes from the newest "
-                         "step-numbered checkpoint, saves every --ckpt-every steps "
-                         "and on SIGTERM (preemption)")
-    ap.add_argument("--ckpt-every", type=int, default=50)
-    ap.add_argument("--log-every", type=int, default=1)
-    args = ap.parse_args()
-
-    if args.cpu_devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.cpu_devices}"
-        )
-    import jax
-
-    if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
-
-    from distributed_sigmoid_loss_tpu.data import SyntheticImageText
-    from distributed_sigmoid_loss_tpu.models import SigLIP
-    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
-    from distributed_sigmoid_loss_tpu.train import (
-        PreemptionGuard,
-        create_train_state,
-        make_optimizer,
-        make_train_step,
-        train_resilient,
-    )
-    from distributed_sigmoid_loss_tpu.utils.config import (
-        LossConfig,
-        SigLIPConfig,
-        TrainConfig,
-    )
-    from distributed_sigmoid_loss_tpu.utils.logging import MetricsLogger
-
-    cfg = SigLIPConfig.tiny_test() if args.tiny else SigLIPConfig.b16()
-    mesh = make_mesh()
-    print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())}", file=sys.stderr)
-
-    model = SigLIP(cfg)
-    tx = make_optimizer(
-        TrainConfig(learning_rate=args.lr, warmup_steps=5, total_steps=max(args.steps, 10))
-    )
-    data = iter(SyntheticImageText(cfg, args.batch))
-    first = next(data)
-
-    state = create_train_state(jax.random.key(0), model, tx, first, mesh)
-    step_fn, shardings = make_train_step(
-        model, mesh, LossConfig(variant=args.variant, precision="default")
-    )
-
-    logger = MetricsLogger(every=args.log_every)
-
-    def device_batches(skip: int = 0):
-        # The synthetic pipeline is deterministic per position: on resume, skip
-        # the batches the checkpointed steps already consumed so the resumed run
-        # sees the same stream an uninterrupted run would.
-        if skip == 0:
-            yield jax.device_put(first, shardings)
-        for i, b in enumerate(data, start=1):
-            if i >= skip:
-                yield jax.device_put(b, shardings)
-
-    if args.ckpt_dir:
-        # Preemption-safe resilient loop: resumes from the newest checkpoint in
-        # --ckpt-dir, saves every --ckpt-every steps and on SIGTERM, rolls back
-        # on a non-finite loss.
-        from distributed_sigmoid_loss_tpu.train import latest_step
-
-        skip = latest_step(args.ckpt_dir) or 0
-        with PreemptionGuard() as guard:
-            state, report = train_resilient(
-                state,
-                step_fn,
-                device_batches(skip),
-                total_steps=args.steps,
-                ckpt_dir=args.ckpt_dir,
-                ckpt_every=args.ckpt_every,
-                guard=guard,
-                on_metrics=lambda i, m: logger.log(
-                    i, {k: float(v) for k, v in m.items()}
-                ),
-            )
-        print(
-            f"resilient loop: steps {report.start_step}->{report.final_step}, "
-            f"checkpoints at {report.checkpoints}"
-            + (" (preempted)" if report.preempted else ""),
-            file=sys.stderr,
-        )
-    else:
-        # 1-based step numbers, matching train_resilient's on_metrics contract.
-        for i, batch in zip(range(1, args.steps + 1), device_batches()):
-            state, metrics = step_fn(state, batch)
-            logger.log(i, {k: float(v) for k, v in metrics.items()})
-
-    # Zero-shot retrieval on a held-out synthetic batch (the model normalizes its
-    # embeddings already).
-    from distributed_sigmoid_loss_tpu.eval import retrieval_metrics
-
-    held_out = jax.device_put(next(iter(data)), shardings)
-    zimg, ztxt, _ = model.apply(
-        {"params": state.params}, held_out["images"], held_out["tokens"]
-    )
-    rm = retrieval_metrics(zimg, ztxt, mesh=mesh, ks=(1, 5))
-    print({k: round(float(v), 4) for k, v in rm.items()}, file=sys.stderr)
-
+from distributed_sigmoid_loss_tpu.cli import main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(["train"] + sys.argv[1:]))

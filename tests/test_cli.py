@@ -1,0 +1,94 @@
+"""CLI smoke tests: the package entry point drives train and eval end-to-end.
+
+Run as subprocesses (the CLI owns its own platform bring-up, like the reference's
+``__main__`` harnesses, /root/reference/test_distributed_sigmoid_loss.py:144-148).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=240):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the CLI sets its own platform via --cpu-devices
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_sigmoid_loss_tpu", *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+
+
+def test_train_tiny_smoke():
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "3", "--batch", "16"]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # Per-step metrics JSONL on stdout, retrieval metrics at the end on stderr.
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert [l["step"] for l in lines] == [1, 2, 3]
+    assert all("loss" in l and "t" in l and "bias" in l for l in lines)
+    assert "i2t_recall@1" in proc.stderr
+
+
+def test_eval_tiny_smoke():
+    proc = _run(
+        ["eval", "--cpu-devices", "8", "--tiny", "--batch", "16", "--classes", "4"]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout.strip().splitlines()[-1]
+    assert "zeroshot_top@1" in out and "i2t_recall@1" in out
+
+
+def test_train_then_eval_checkpoint_roundtrip(tmp_path):
+    """The documented workflow: train writes step-numbered checkpoints, eval
+    restores the newest one (was broken: eval read the root dir directly)."""
+    ck = str(tmp_path / "ck")
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "3", "--batch", "16",
+         "--ckpt-dir", ck, "--ckpt-every", "2"]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    proc = _run(
+        ["eval", "--cpu-devices", "8", "--tiny", "--batch", "16", "--classes", "4",
+         "--ckpt-dir", ck]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "restored step 3" in proc.stderr
+    assert "zeroshot_top@1" in proc.stdout
+
+
+def test_eval_missing_checkpoint_clear_error(tmp_path):
+    proc = _run(
+        ["eval", "--cpu-devices", "8", "--tiny", "--batch", "16",
+         "--ckpt-dir", str(tmp_path / "nope")]
+    )
+    assert proc.returncode == 2
+    assert "no checkpoint found" in proc.stderr
+
+
+def test_bench_rejects_cpu_devices():
+    proc = _run(["bench", "--cpu-devices", "8"], timeout=60)
+    assert proc.returncode == 2
+    assert "real chip" in proc.stderr
+
+
+def test_example_delegates_to_cli():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "examples", "train_siglip.py"),
+            "--cpu-devices", "8", "--tiny", "--steps", "2", "--batch", "16",
+        ],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "mesh:" in proc.stderr

@@ -1,0 +1,94 @@
+"""Zero-shot classification eval on the emulated 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sigmoid_loss_tpu.eval import (
+    classifier_weights,
+    classify_ranks,
+    zeroshot_metrics,
+)
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import l2_normalize
+from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+
+def _setup(n=32, n_classes=10, d=16, noise=0.0, seed=0):
+    rng = np.random.default_rng(seed)
+    classifier = l2_normalize(
+        jnp.asarray(rng.standard_normal((n_classes, d)), jnp.float32)
+    )
+    labels = jnp.asarray(rng.integers(0, n_classes, n), jnp.int32)
+    zimg = l2_normalize(
+        jnp.asarray(
+            np.asarray(classifier)[np.asarray(labels)]
+            + noise * rng.standard_normal((n, d)),
+            jnp.float32,
+        )
+    )
+    return zimg, classifier, labels
+
+
+def test_perfect_images_top1():
+    zimg, classifier, labels = _setup(noise=0.0)
+    assert np.all(np.asarray(classify_ranks(zimg, classifier, labels)) == 0)
+    m = zeroshot_metrics(zimg, classifier, labels)
+    assert float(m["top@1"]) == 1.0
+    assert float(m["top@5"]) == 1.0
+
+
+def test_known_ranks_tiny_case():
+    # 2 images, 3 classes with hand-readable logits.
+    classifier = jnp.eye(3, dtype=jnp.float32)
+    zimg = jnp.asarray([[0.1, 0.9, 0.0], [1.0, 0.0, 0.0]], jnp.float32)
+    labels = jnp.asarray([0, 0], jnp.int32)
+    ranks = np.asarray(classify_ranks(zimg, classifier, labels))
+    # Image 0's true class 0 (logit .1) is beaten only by class 1 (logit .9).
+    np.testing.assert_array_equal(ranks, [1, 0])
+    m = zeroshot_metrics(zimg, classifier, labels, ks=(1, 2))
+    assert float(m["top@1"]) == 0.5
+    assert float(m["top@2"]) == 1.0
+
+
+def test_sharded_matches_single_device():
+    zimg, classifier, labels = _setup(n=40, noise=0.8, seed=3)
+    mesh = make_mesh(8)
+    single = zeroshot_metrics(zimg, classifier, labels)
+    sharded = zeroshot_metrics(zimg, classifier, labels, mesh=mesh)
+    assert single.keys() == sharded.keys()
+    for k in single:
+        np.testing.assert_allclose(float(sharded[k]), float(single[k]), rtol=0, atol=0)
+
+
+def test_accuracy_monotone_in_k_and_degrades_with_noise():
+    zimg, classifier, labels = _setup(n=64, noise=1.2, seed=4)
+    m = zeroshot_metrics(zimg, classifier, labels, ks=(1, 3, 5))
+    assert float(m["top@1"]) <= float(m["top@3"]) <= float(m["top@5"])
+    clean = zeroshot_metrics(*_setup(n=64, noise=0.05, seed=4)[:1],
+                             classifier, labels)  # same classifier/labels, low noise
+    assert float(clean["top@1"]) >= float(m["top@1"])
+
+
+def test_classifier_weights_template_ensembling():
+    rng = np.random.default_rng(7)
+    base = rng.standard_normal((5, 1, 8))
+    # Templates = scaled copies of one direction: the ensemble must be that
+    # direction, unit-norm, regardless of per-template magnitudes.
+    templates = jnp.asarray(
+        np.concatenate([base * 0.5, base * 3.0, base * 1.7], axis=1), jnp.float32
+    )
+    w = classifier_weights(templates)
+    np.testing.assert_allclose(
+        np.asarray(jnp.linalg.norm(w, axis=-1)), 1.0, rtol=1e-6
+    )
+    expected = np.asarray(l2_normalize(jnp.asarray(base[:, 0], jnp.float32)))
+    np.testing.assert_allclose(np.asarray(w), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_ties_resolve_optimistically():
+    # Duplicate class rows: the true class ties with its duplicate but a tie is
+    # not "strictly greater", so the rank stays 0 (same convention as retrieval).
+    classifier = jnp.asarray([[1.0, 0.0], [1.0, 0.0], [0.0, 1.0]], jnp.float32)
+    zimg = jnp.asarray([[1.0, 0.0]], jnp.float32)
+    labels = jnp.asarray([1], jnp.int32)
+    assert int(classify_ranks(zimg, classifier, labels)[0]) == 0
