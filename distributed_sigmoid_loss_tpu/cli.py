@@ -60,7 +60,44 @@ def cmd_train(args) -> int:
     _bootstrap_devices(args)
     import jax
 
-    from distributed_sigmoid_loss_tpu.data import SyntheticImageText
+    if args.coordinator:
+        if args.num_processes < 1 or args.process_id < 0:
+            print(
+                "--coordinator requires --num-processes >= 1 and --process-id >= 0 "
+                "(every process runs the same command with its own --process-id)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.batch % args.num_processes:
+            print(
+                f"--batch {args.batch} must be divisible by --num-processes "
+                f"{args.num_processes} (batch is GLOBAL; each process contributes "
+                f"batch/num_processes rows)",
+                file=sys.stderr,
+            )
+            return 2
+        # Multi-process run: rendezvous BEFORE any other jax use so every host
+        # sees the same global device list (the pjit single-controller model).
+        from distributed_sigmoid_loss_tpu.parallel.multihost import (
+            initialize_multihost,
+        )
+
+        try:
+            initialize_multihost(
+                coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+            )
+        except Exception as e:
+            # Environmental (ports/sandbox): a distinct exit code lets harnesses
+            # skip rather than fail — same contract as tests/_multihost_worker.py.
+            print(f"INIT_FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+            return 3
+
+    from distributed_sigmoid_loss_tpu.data import (
+        SyntheticImageText,
+        global_batch_from_local,
+    )
     from distributed_sigmoid_loss_tpu.models import SigLIP
     from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
     from distributed_sigmoid_loss_tpu.train import (
@@ -76,7 +113,12 @@ def cmd_train(args) -> int:
 
     cfg = _model_config(args)
     mesh = make_mesh()
-    print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())}", file=sys.stderr)
+    pidx, pcnt = jax.process_index(), jax.process_count()
+    print(
+        f"mesh: {dict(mesh.shape)} devices={len(jax.devices())}"
+        + (f" process {pidx}/{pcnt}" if pcnt > 1 else ""),
+        file=sys.stderr,
+    )
 
     model = SigLIP(cfg)
     tx = make_optimizer(
@@ -97,15 +139,31 @@ def cmd_train(args) -> int:
 
     logger = MetricsLogger(every=args.log_every)
 
+    def place(b):
+        if pcnt == 1:
+            return jax.device_put(b, shardings)
+        # Reference-style full-batch-then-slice (test_distributed_sigmoid_loss.py:
+        # 57-68): every host generates the same deterministic global batch and
+        # contributes the process-order slice its own devices hold.
+        import numpy as np
+
+        local = jax.tree.map(
+            lambda x: np.asarray(x).reshape(
+                pcnt, x.shape[0] // pcnt, *x.shape[1:]
+            )[pidx],
+            b,
+        )
+        return global_batch_from_local(local, mesh)
+
     def device_batches(skip: int = 0):
         # The synthetic pipeline is deterministic per position: on resume, skip
         # the batches the checkpointed steps already consumed so the resumed run
         # sees the same stream an uninterrupted run would.
         if skip == 0:
-            yield jax.device_put(first, shardings)
+            yield place(first)
         for i, b in enumerate(data, start=1):
             if i >= skip:
-                yield jax.device_put(b, shardings)
+                yield place(b)
 
     if args.ckpt_dir:
         # Preemption-safe resilient loop: resumes from the newest checkpoint in
@@ -141,7 +199,7 @@ def cmd_train(args) -> int:
     # its embeddings already).
     from distributed_sigmoid_loss_tpu.eval import retrieval_metrics
 
-    held_out = jax.device_put(next(iter(data)), shardings)
+    held_out = place(next(iter(data)))
     zimg, ztxt, _ = model.apply(
         {"params": state.params}, held_out["images"], held_out["tokens"]
     )
@@ -273,6 +331,15 @@ def main(argv=None) -> int:
                          "and on SIGTERM (preemption)")
     tr.add_argument("--ckpt-every", type=int, default=50)
     tr.add_argument("--log-every", type=int, default=1)
+    tr.add_argument("--coordinator", default="",
+                    help="multi-process rendezvous address host:port — every "
+                         "process runs this same command with its own --process-id; "
+                         "--batch stays GLOBAL and must be divisible by "
+                         "--num-processes")
+    tr.add_argument("--num-processes", type=int, default=0,
+                    help="total process count (required with --coordinator)")
+    tr.add_argument("--process-id", type=int, default=-1,
+                    help="this process's 0-based rank (required with --coordinator)")
 
     ev = sub.add_parser("eval", help="zero-shot retrieval + classification")
     ev.add_argument("--batch", type=int, default=64)

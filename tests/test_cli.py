@@ -80,6 +80,54 @@ def test_bench_rejects_cpu_devices():
     assert "real chip" in proc.stderr
 
 
+def test_train_two_process_coordinator():
+    """`train --coordinator` runs one job across two real OS processes (each with
+    2 virtual CPU devices) and both report identical global losses."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "distributed_sigmoid_loss_tpu", "train",
+                "--cpu-devices", "2", "--tiny", "--steps", "2", "--batch", "16",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", "2", "--process-id", str(i),
+            ],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:  # a crashed peer must not leave the other at rendezvous
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        if p.returncode == 3:
+            import pytest
+
+            pytest.skip(f"coordinator unavailable: {out[-500:]}")
+        assert p.returncode == 0, out[-2000:]
+        assert "process" in out  # multihost banner printed
+    losses = [
+        [json.loads(l)["loss"] for l in out.splitlines()
+         if l.startswith("{") and "loss" in l]
+        for out in outs
+    ]
+    assert losses[0] and losses[0] == losses[1], losses
+
+
 def test_example_delegates_to_cli():
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
