@@ -1,6 +1,7 @@
 """Text tower: non-causal transformer over tokenized captions (SigLIP-style), with MAP
-pooling and projection into the shared embedding space. Embedding normalization stays
-outside the model (reference convention, test_distributed_sigmoid_loss.py:96-101)."""
+("map") or last-token ("last", HF-format) pooling and projection into the shared
+embedding space. Embedding normalization stays outside the model (reference
+convention, test_distributed_sigmoid_loss.py:96-101)."""
 
 from __future__ import annotations
 
@@ -42,6 +43,11 @@ class TextTransformer(nn.Module):
             causal=cfg.causal, name="encoder",
         )(x)
 
-        x = MapHead(cfg.width, cfg.num_heads, cfg.mlp_ratio, dtype, name="map_head")(x)
+        if cfg.pool == "map":
+            x = MapHead(cfg.width, cfg.num_heads, cfg.mlp_ratio, dtype, name="map_head")(x)
+        else:
+            # HF-format SigLIP: the LAST token's hidden state is the pooled
+            # representation (modeling_siglip.SiglipTextTransformer.forward).
+            x = x[:, -1]
         x = nn.Dense(cfg.embed_dim, dtype=dtype, name="proj")(x)
         return x.astype(jnp.float32)

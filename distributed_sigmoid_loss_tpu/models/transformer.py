@@ -54,12 +54,14 @@ def _remat_policy(name: str):
 
 class Mlp(nn.Module):
     width: int
-    mlp_ratio: int
+    # May be fractional (HF so400m: 4304/1152); the hidden dim is rounded back
+    # to the exact integer.
+    mlp_ratio: int | float
     dtype: Any
 
     @nn.compact
     def __call__(self, x):
-        hidden = self.width * self.mlp_ratio
+        hidden = int(round(self.width * self.mlp_ratio))
         # Column-parallel in, row-parallel out: the tp all-reduce happens once, after wo.
         wi = nn.Dense(
             hidden,
@@ -211,7 +213,7 @@ class Block(nn.Module):
 
     width: int
     num_heads: int
-    mlp_ratio: int
+    mlp_ratio: int | float
     dtype: Any
     sp_axis: str | None = None
     sp_impl: str = "ring"
@@ -237,7 +239,7 @@ class _ScanBody(nn.Module):
 
     width: int
     num_heads: int
-    mlp_ratio: int
+    mlp_ratio: int | float
     dtype: Any
     sp_axis: str | None = None
     sp_impl: str = "ring"
@@ -261,7 +263,7 @@ class Encoder(nn.Module):
     width: int
     depth: int
     num_heads: int
-    mlp_ratio: int
+    mlp_ratio: int | float
     dtype: Any
     remat: bool = False
     scan_layers: bool = False
@@ -320,7 +322,7 @@ class MapHead(nn.Module):
 
     width: int
     num_heads: int
-    mlp_ratio: int
+    mlp_ratio: int | float
     dtype: Any
 
     @nn.compact

@@ -55,5 +55,11 @@ class ViT(nn.Module):
         else:
             x = x.mean(axis=1)
 
-        x = nn.Dense(cfg.embed_dim, dtype=dtype, name="proj")(x)
+        if cfg.use_proj:
+            x = nn.Dense(cfg.embed_dim, dtype=dtype, name="proj")(x)
+        elif cfg.embed_dim != cfg.width:
+            raise ValueError(
+                f"use_proj=False (HF-format) requires embed_dim == width, got "
+                f"{cfg.embed_dim} != {cfg.width}"
+            )
         return x.astype(jnp.float32)

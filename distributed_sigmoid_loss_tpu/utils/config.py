@@ -35,9 +35,13 @@ class ViTConfig:
     width: int = 768
     depth: int = 12
     num_heads: int = 12
-    mlp_ratio: int = 4
+    mlp_ratio: int | float = 4
     embed_dim: int = 512  # shared image-text embedding space
     pool: Literal["gap", "map"] = "map"  # SigLIP uses MAP (attention-pool) heads
+    # HF-format SigLIP has no vision projection (the MAP head output IS the
+    # embedding, so embed_dim must equal width); ours defaults to a projection
+    # into the shared space like open_clip.
+    use_proj: bool = True
     dtype: str = "bfloat16"  # activation dtype on TPU; params stay fp32
     remat: bool = True  # jax.checkpoint each block: trade FLOPs for HBM
     scan_layers: bool = True  # lax.scan over blocks: O(1) compile in depth
@@ -73,8 +77,11 @@ class TextConfig:
     width: int = 768
     depth: int = 12
     num_heads: int = 12
-    mlp_ratio: int = 4
+    mlp_ratio: int | float = 4
     embed_dim: int = 512
+    # "map" = attention pooling (open_clip SigLIP); "last" = last-token hidden
+    # state (HF-format SigLIP, modeling_siglip.SiglipTextTransformer).
+    pool: Literal["map", "last"] = "map"
     dtype: str = "bfloat16"
     remat: bool = True
     scan_layers: bool = True
