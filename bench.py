@@ -52,8 +52,11 @@ def model_forward_flops_per_pair(cfg) -> float:
     vit += 2.0 * s_img * v.patch_size * v.patch_size * 3 * v.width
     if v.pool == "map":
         vit += 4.0 * s_img * v.width * v.width
-    vit += 2.0 * v.width * v.embed_dim
+    if v.use_proj:
+        vit += 2.0 * v.width * v.embed_dim
     txt = transformer_forward_flops(t.context_length, t.width, t.depth, t.mlp_ratio)
+    if t.pool == "map":
+        txt += 4.0 * t.context_length * t.width * t.width
     txt += 2.0 * t.width * t.embed_dim
     return vit + txt
 
@@ -67,7 +70,8 @@ def main():
     ap.add_argument("batch", nargs="?", type=int, default=288,
                     help="per-chip pairs per optimizer step (before accumulation)")
     ap.add_argument("steps", nargs="?", type=int, default=10)
-    ap.add_argument("model", nargs="?", default="b16", choices=["b16", "l14", "tiny"])
+    ap.add_argument("model", nargs="?", default="b16",
+                    choices=["b16", "l14", "so400m", "tiny"])
     ap.add_argument("--use-pallas", action="store_true",
                     help="fused Pallas loss kernel instead of the XLA-fused path")
     ap.add_argument("--accum", type=int, default=1,
@@ -80,6 +84,8 @@ def main():
                     help="save ALL text-tower activations (measured: OOMs at the "
                          "bench config — the layer-scan stacks every saved tensor; "
                          "kept for sweeps at smaller batches)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="shard optimizer state over dp (ZeRO-1); no-op on 1 chip")
     ap.add_argument("--scan-layers", action="store_true",
                     help="lax.scan over tower depth instead of the unrolled "
                          "default (O(1) compile time in depth, ~1.3%% slower)")
@@ -114,6 +120,10 @@ def main():
     if args.model == "l14":
         # L/14 needs full remat at useful batch sizes (save_hot exceeds v5e HBM).
         cfg = SigLIPConfig.l14()
+    elif args.model == "so400m":
+        # ~878M params: adam state alone is ~10.5G of the 16G HBM; small batch,
+        # full remat.
+        cfg = SigLIPConfig.so400m()
     elif args.model == "tiny":
         cfg = SigLIPConfig.tiny_test()  # harness smoke config (CPU-runnable)
     else:
@@ -156,12 +166,14 @@ def main():
 
     batch = make_batch(jax.random.key(0))
 
-    state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+    state = create_train_state(
+        jax.random.key(0), model, tx, batch, mesh, zero1=args.zero1
+    )
     loss_cfg = LossConfig(
         variant=args.variant, precision=args.precision, use_pallas=args.use_pallas
     )
     step, shardings = make_train_step(
-        model, mesh, loss_cfg, accum_steps=args.accum
+        model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1
     )
     batch = jax.device_put(batch, shardings)
 
@@ -227,6 +239,8 @@ def main():
     # magnitude low; publishing a 0.06 "hw_util" next to a 0.51 MFU would be noise.
     hw_tflops = None
     record["scan_layers"] = args.scan_layers
+    if args.zero1:
+        record["zero1"] = True
     if args.no_text_remat:
         record["no_text_remat"] = True
     if hw_flops_per_step_per_dev is not None:

@@ -52,6 +52,7 @@ def _model_config(args):
     return {
         "tiny": SigLIPConfig.tiny_test,
         "l14": SigLIPConfig.l14,
+        "so400m": SigLIPConfig.so400m,
         "b16": SigLIPConfig.b16,
     }[name]()
 
@@ -129,12 +130,15 @@ def cmd_train(args) -> int:
     data = iter(SyntheticImageText(cfg, args.batch))
     first = next(data)
 
-    state = create_train_state(jax.random.key(0), model, tx, first, mesh)
+    state = create_train_state(
+        jax.random.key(0), model, tx, first, mesh, zero1=args.zero1
+    )
     step_fn, shardings = make_train_step(
         model,
         mesh,
         LossConfig(variant=args.variant, precision="default"),
         accum_steps=args.accum,
+        zero1=args.zero1,
     )
 
     logger = MetricsLogger(every=args.log_every)
@@ -321,9 +325,12 @@ def main(argv=None) -> int:
     tr.add_argument("--batch", type=int, default=64, help="global batch size")
     tr.add_argument("--variant", choices=["all_gather", "ring"], default="ring")
     tr.add_argument("--lr", type=float, default=1e-3)
-    tr.add_argument("--model", choices=["b16", "l14", "tiny"], default="b16")
+    tr.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"], default="b16")
     tr.add_argument("--tiny", action="store_true", help="alias for --model tiny")
     tr.add_argument("--accum", type=int, default=1, help="grad-accumulation microsteps")
+    tr.add_argument("--zero1", action="store_true",
+                    help="shard optimizer state over dp (ZeRO-1) — fits "
+                         "so400m-class towers in v5e HBM")
     tr.add_argument("--cpu-devices", type=int, default=0, help="emulate N CPU devices")
     tr.add_argument("--ckpt-dir", default="",
                     help="checkpoint/resume directory: resumes from the newest "
@@ -344,7 +351,7 @@ def main(argv=None) -> int:
     ev = sub.add_parser("eval", help="zero-shot retrieval + classification")
     ev.add_argument("--batch", type=int, default=64)
     ev.add_argument("--classes", type=int, default=10)
-    ev.add_argument("--model", choices=["b16", "l14", "tiny"], default="b16")
+    ev.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"], default="b16")
     ev.add_argument("--tiny", action="store_true", help="alias for --model tiny")
     ev.add_argument("--cpu-devices", type=int, default=0)
     ev.add_argument("--ckpt-dir", default="", help="restore params from this checkpoint")

@@ -3,6 +3,7 @@ from distributed_sigmoid_loss_tpu.train.train_step import (  # noqa: F401
     create_train_state,
     init_params,
     make_train_step,
+    zero1_constrain,
 )
 from distributed_sigmoid_loss_tpu.train.checkpoint import (  # noqa: F401
     save_checkpoint,

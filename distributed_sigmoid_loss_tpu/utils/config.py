@@ -127,6 +127,22 @@ class SigLIPConfig:
         )
 
     @classmethod
+    def so400m(cls) -> "SigLIPConfig":
+        """SoViT-400m/14 — the shape-optimized flagship of the SigLIP release
+        (google/siglip-so400m-patch14-224), HF-shaped so `models.hf_import` weights
+        drop in: no vision projection, last-token text pooling, fractional MLP."""
+        return cls(
+            vision=ViTConfig(
+                patch_size=14, width=1152, depth=27, num_heads=16,
+                mlp_ratio=4304 / 1152, embed_dim=1152, use_proj=False,
+            ),
+            text=TextConfig(
+                width=1152, depth=27, num_heads=16, mlp_ratio=4304 / 1152,
+                embed_dim=1152, pool="last",
+            ),
+        )
+
+    @classmethod
     def tiny_test(cls) -> "SigLIPConfig":
         return cls(vision=ViTConfig.tiny_test(), text=TextConfig.tiny_test())
 
