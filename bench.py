@@ -89,6 +89,9 @@ def main():
     ap.add_argument("--scan-layers", action="store_true",
                     help="lax.scan over tower depth instead of the unrolled "
                          "default (O(1) compile time in depth, ~1.3%% slower)")
+    ap.add_argument("--profile", metavar="DIR", default="",
+                    help="capture a jax.profiler trace of the timed steps into DIR "
+                         "(view with TensorBoard or ui.perfetto.dev)")
     args = ap.parse_args()
 
     import jax
@@ -199,11 +202,17 @@ def main():
         state, metrics = compiled(state, batch)
     float(metrics["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, metrics = compiled(state, batch)
-    final_loss = float(metrics["loss"])
-    dt = time.perf_counter() - t0
+    import contextlib
+
+    from distributed_sigmoid_loss_tpu.utils.profiling import trace
+
+    profile_ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
+    with profile_ctx:
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, metrics = compiled(state, batch)
+        final_loss = float(metrics["loss"])
+        dt = time.perf_counter() - t0
     assert jnp.isfinite(final_loss), f"non-finite loss in bench: {final_loss}"
 
     pairs_per_sec_per_chip = global_b * args.steps / dt / n_dev

@@ -1,0 +1,156 @@
+"""JAX-native image augmentation for contrastive pre-training.
+
+The reference has no data layer at all; real SigLIP training needs the standard
+augmentation stack (Inception-style random resized crop + horizontal flip, optional
+color jitter). TPU-first design constraints:
+
+- **Static shapes under jit**: a data-dependent crop SIZE would be a dynamic shape,
+  which XLA cannot compile. Instead the sampled crop box becomes a per-sample
+  ``scale``/``translation`` for :func:`jax.image.scale_and_translate`, whose output
+  shape is fixed — the crop-and-resize is one fused gather/convolution, vmapped over
+  the batch.
+- **Key-driven determinism**: every op takes an explicit ``jax.random`` key; the same
+  key reproduces the same batch bit-for-bit (the reference's seeded-data philosophy,
+  test_distributed_sigmoid_loss.py:15-32, applied to augmentation).
+- **Device-resident**: all ops are jittable and run on-chip, so augmentation overlaps
+  the previous step's compute when composed with ``data.prefetch``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "random_flip",
+    "random_resized_crop",
+    "color_jitter",
+    "normalize",
+    "augment_batch",
+]
+
+
+def random_flip(key: jax.Array, images: jax.Array) -> jax.Array:
+    """Per-sample horizontal flip with probability 0.5. images: (b, h, w, c)."""
+    flip = jax.random.bernoulli(key, 0.5, (images.shape[0],))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+
+
+def _sample_crop_box(key, h, w, scale, ratio):
+    """Inception-style crop: area fraction ~ U(scale), log-aspect ~ U(log(ratio)).
+
+    Returns (crop_h, crop_w, top, left) as f32 scalars (continuous coordinates —
+    the resize interpolates, so there is no need to round to integer pixels).
+    Degenerate draws (crop larger than the image) fall back to a center crop of
+    the largest valid size, matching torchvision's fallback semantics.
+    """
+    k_area, k_ratio, k_top, k_left = jax.random.split(key, 4)
+    area = h * w * jax.random.uniform(k_area, minval=scale[0], maxval=scale[1])
+    log_r = jax.random.uniform(
+        k_ratio, minval=jnp.log(ratio[0]), maxval=jnp.log(ratio[1])
+    )
+    r = jnp.exp(log_r)
+    crop_w = jnp.sqrt(area * r)
+    crop_h = jnp.sqrt(area / r)
+    # Fallback: clamp to the image, preserving the sampled aspect where possible.
+    clamp = jnp.minimum(jnp.minimum(h / crop_h, w / crop_w), 1.0)
+    crop_h = crop_h * clamp
+    crop_w = crop_w * clamp
+    top = jax.random.uniform(k_top) * (h - crop_h)
+    left = jax.random.uniform(k_left) * (w - crop_w)
+    return crop_h, crop_w, top, left
+
+
+def random_resized_crop(
+    key: jax.Array,
+    images: jax.Array,
+    out_size: int,
+    scale: tuple[float, float] = (0.08, 1.0),
+    ratio: tuple[float, float] = (3 / 4, 4 / 3),
+    method: str = "bilinear",
+) -> jax.Array:
+    """Per-sample Inception crop + resize to (out_size, out_size), static shapes.
+
+    images: (b, h, w, c) → (b, out_size, out_size, c). The crop box is applied as
+    a ``scale_and_translate`` so the whole op is one fixed-shape resize kernel.
+    """
+    b, h, w, c = images.shape
+
+    def one(img, k):
+        crop_h, crop_w, top, left = _sample_crop_box(k, h, w, scale, ratio)
+        # Output pixel o maps to input pixel top + o * crop_h/out_size:
+        # scale_and_translate computes in = (out - translation) / scale.
+        scale_hw = jnp.stack([out_size / crop_h, out_size / crop_w])
+        translation = jnp.stack([-top * out_size / crop_h, -left * out_size / crop_w])
+        return jax.image.scale_and_translate(
+            img, (out_size, out_size, c), (0, 1, 2),
+            jnp.concatenate([scale_hw, jnp.ones(1)]),
+            jnp.concatenate([translation, jnp.zeros(1)]),
+            method=method,
+        )
+
+    return jax.vmap(one)(images, jax.random.split(key, b))
+
+
+def color_jitter(
+    key: jax.Array,
+    images: jax.Array,
+    brightness: float = 0.4,
+    contrast: float = 0.4,
+    saturation: float = 0.4,
+) -> jax.Array:
+    """Per-sample brightness/contrast/saturation jitter (factors ~ U(1±x))."""
+    b = images.shape[0]
+    kb, kc, ks = jax.random.split(key, 3)
+
+    def factors(k, amount):
+        return jax.random.uniform(
+            k, (b, 1, 1, 1), minval=1.0 - amount, maxval=1.0 + amount
+        )
+
+    out = images * factors(kb, brightness)
+    mean = out.mean(axis=(1, 2, 3), keepdims=True)
+    out = (out - mean) * factors(kc, contrast) + mean
+    gray = out.mean(axis=-1, keepdims=True)
+    out = (out - gray) * factors(ks, saturation) + gray
+    return out
+
+
+def normalize(
+    images: jax.Array,
+    mean: Sequence[float] = (0.5, 0.5, 0.5),
+    std: Sequence[float] = (0.5, 0.5, 0.5),
+) -> jax.Array:
+    """Channel normalization; SigLIP's published preprocessing is (0.5, 0.5),
+    mapping [0, 1] floats to [-1, 1]. Integer input is treated as [0, 255] pixel
+    values: scaled to [0, 1] first (casting 0.5 to an int dtype would otherwise
+    truncate to 0 and divide by zero)."""
+    if not jnp.issubdtype(images.dtype, jnp.floating):
+        images = images.astype(jnp.float32) / 255.0
+    mean = jnp.asarray(mean, images.dtype)
+    std = jnp.asarray(std, images.dtype)
+    return (images - mean) / std
+
+
+def augment_batch(
+    key: jax.Array,
+    images: jax.Array,
+    out_size: int,
+    train: bool = True,
+    jitter: float = 0.0,
+) -> jax.Array:
+    """The standard contrastive train transform: random resized crop + flip
+    (+ optional color jitter), then SigLIP normalization. ``train=False`` is the
+    eval transform: plain resize + normalize. Jittable; fixed output shapes."""
+    if not train:
+        b, h, w, c = images.shape
+        resized = jax.image.resize(images, (b, out_size, out_size, c), "bilinear")
+        return normalize(resized)
+    k_crop, k_flip, k_jit = jax.random.split(key, 3)
+    out = random_resized_crop(k_crop, images, out_size)
+    out = random_flip(k_flip, out)
+    if jitter:
+        out = color_jitter(k_jit, out, jitter, jitter, jitter)
+    return normalize(out)

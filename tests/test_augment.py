@@ -1,0 +1,96 @@
+"""JAX-native augmentation: static shapes, key determinism, op semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.data.augment import (
+    augment_batch,
+    color_jitter,
+    normalize,
+    random_flip,
+    random_resized_crop,
+)
+
+
+def _images(b=4, h=24, w=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.uniform(0, 1, (b, h, w, 3)), jnp.float32)
+
+
+def test_flip_is_involution_and_per_sample():
+    imgs = _images()
+    key = jax.random.key(0)
+    out = random_flip(key, imgs)
+    # Each output row is either the original or its mirror.
+    for i in range(imgs.shape[0]):
+        a, o = np.asarray(out[i]), np.asarray(imgs[i])
+        assert np.array_equal(a, o) or np.array_equal(a, o[:, ::-1, :])
+    # Some sample flips with key 0..4 (probability 1 - 0.5^20 it's not all-same).
+    outs = [np.asarray(random_flip(jax.random.key(s), imgs)) for s in range(5)]
+    assert any(not np.array_equal(o, np.asarray(imgs)) for o in outs)
+
+
+def test_crop_shapes_and_determinism():
+    imgs = _images()
+    key = jax.random.key(1)
+    out = random_resized_crop(key, imgs, 16)
+    assert out.shape == (4, 16, 16, 3)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(random_resized_crop(key, imgs, 16))
+    )
+    # Different key -> different crop.
+    out2 = random_resized_crop(jax.random.key(2), imgs, 16)
+    assert not np.array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_full_image_crop_is_plain_resize():
+    """scale=(1,1), ratio=(1,1) on a square image must reduce to a resize."""
+    imgs = _images(h=32, w=32)
+    out = random_resized_crop(
+        jax.random.key(0), imgs, 16, scale=(1.0, 1.0), ratio=(1.0, 1.0)
+    )
+    want = jax.image.resize(imgs, (4, 16, 16, 3), "bilinear")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_crop_values_within_input_range():
+    imgs = _images()
+    out = random_resized_crop(jax.random.key(3), imgs, 16)
+    assert np.isfinite(np.asarray(out)).all()
+    # Bilinear interpolation of [0, 1] data stays in [0, 1] (small eps for fp).
+    assert float(out.min()) >= -1e-5 and float(out.max()) <= 1 + 1e-5
+
+
+def test_color_jitter_identity_at_zero():
+    imgs = _images()
+    out = color_jitter(jax.random.key(0), imgs, 0.0, 0.0, 0.0)
+    # Identity up to the (x - m) + m float round-trip in contrast/saturation.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(imgs), rtol=1e-6, atol=1e-6)
+
+
+def test_normalize_siglip_range():
+    imgs = _images()
+    out = normalize(imgs)  # (0.5, 0.5): [0,1] -> [-1,1]
+    assert float(out.min()) >= -1.0 - 1e-6 and float(out.max()) <= 1.0 + 1e-6
+
+
+@pytest.mark.parametrize("train", [True, False])
+def test_augment_batch_jits(train):
+    imgs = _images()
+    fn = jax.jit(lambda k, x: augment_batch(k, x, 16, train=train, jitter=0.2))
+    out = fn(jax.random.key(0), imgs)
+    assert out.shape == (4, 16, 16, 3)
+    assert np.isfinite(np.asarray(out)).all()
+    # Deterministic under the same key.
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(fn(jax.random.key(0), imgs))
+    )
+
+
+def test_normalize_uint8_pixels():
+    """Integer input = [0, 255] pixels: 128 -> ~0.0, 255 -> 1.0, 0 -> -1.0."""
+    imgs = jnp.asarray([[[[0, 128, 255]]]], jnp.uint8)
+    out = np.asarray(normalize(imgs))
+    np.testing.assert_allclose(out[0, 0, 0], [-1.0, 0.00392, 1.0], atol=1e-3)

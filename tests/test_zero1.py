@@ -120,8 +120,12 @@ def test_zero1_checkpoint_roundtrip(tmp_path):
     restored = restore_checkpoint(path, state)
     mu = _adam_mu(restored.opt_state)
     assert mu["textual"]["token_embed"]["embedding"].sharding.spec == P("dp")
-    jax.tree.map(
-        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
-        state.params,
-        restored.params,
-    )
+    # Values of BOTH params and the dp-sharded optimizer state must roundtrip —
+    # the sharded moments are the thing this test exists to protect.
+    for a, b in ((state.params, restored.params),
+                 (state.opt_state, restored.opt_state)):
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+            a,
+            b,
+        )
