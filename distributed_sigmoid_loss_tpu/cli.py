@@ -131,7 +131,8 @@ def cmd_train(args) -> int:
     first = next(data)
 
     state = create_train_state(
-        jax.random.key(0), model, tx, first, mesh, zero1=args.zero1
+        jax.random.key(0), model, tx, first, mesh, zero1=args.zero1,
+        ema=args.ema_decay is not None,
     )
     step_fn, shardings = make_train_step(
         model,
@@ -139,6 +140,7 @@ def cmd_train(args) -> int:
         LossConfig(variant=args.variant, precision="default"),
         accum_steps=args.accum,
         zero1=args.zero1,
+        ema_decay=args.ema_decay,
     )
 
     logger = MetricsLogger(every=args.log_every)
@@ -237,7 +239,9 @@ def cmd_eval(args) -> int:
     if args.ckpt_dir:
         # Train writes step-numbered checkpoints of the FULL train state; restore
         # the newest one into a matching structure (optimizer slots are needed
-        # only as the restore target) and keep the params.
+        # only as the restore target) and keep the params. Checkpoints written
+        # with --ema-decay carry an extra `ema` subtree — the restore target must
+        # match, so retry with an EMA-shaped state when the bare one mismatches.
         from distributed_sigmoid_loss_tpu.train import (
             create_train_state,
             make_optimizer,
@@ -245,16 +249,41 @@ def cmd_eval(args) -> int:
         )
         from distributed_sigmoid_loss_tpu.utils.config import TrainConfig
 
+        tx = make_optimizer(TrainConfig())
         state = create_train_state(
-            jax.random.key(0), model, make_optimizer(TrainConfig()), batch, mesh
+            jax.random.key(0), model, tx, batch, mesh, ema=args.ema
         )
-        restored = restore_latest(args.ckpt_dir, state)
+        try:
+            restored = restore_latest(args.ckpt_dir, state)
+        except Exception as e:
+            if "ema" not in str(e).lower():
+                raise
+            if args.ema:
+                # Target had an ema subtree but the checkpoint does not.
+                print(
+                    f"--ema requested but the checkpoint at {args.ckpt_dir} has "
+                    f"no EMA weights (train with --ema-decay)",
+                    file=sys.stderr,
+                )
+                return 2
+            state = create_train_state(
+                jax.random.key(0), model, tx, batch, mesh, ema=True
+            )
+            restored = restore_latest(args.ckpt_dir, state)
         if restored is None:
             print(f"no checkpoint found under {args.ckpt_dir}", file=sys.stderr)
             return 2
         state, step = restored
-        print(f"restored step {step} from {args.ckpt_dir}", file=sys.stderr)
-        params = state.params
+        if args.ema and state.ema is None:
+            print(
+                f"--ema requested but the checkpoint at {args.ckpt_dir} has no "
+                f"EMA weights (train with --ema-decay)",
+                file=sys.stderr,
+            )
+            return 2
+        which = "ema" if args.ema else "params"
+        print(f"restored step {step} ({which}) from {args.ckpt_dir}", file=sys.stderr)
+        params = state.ema if args.ema else state.params
     else:
         # Forward-only eval of a fresh model: params only, no optimizer slots.
         params = init_params(jax.random.key(0), model, batch, mesh)
@@ -331,6 +360,9 @@ def main(argv=None) -> int:
     tr.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over dp (ZeRO-1) — fits "
                          "so400m-class towers in v5e HBM")
+    tr.add_argument("--ema-decay", type=float, default=None,
+                    help="maintain an EMA of the params in the train state "
+                         "(e.g. 0.9999, warmed up)")
     tr.add_argument("--cpu-devices", type=int, default=0, help="emulate N CPU devices")
     tr.add_argument("--ckpt-dir", default="",
                     help="checkpoint/resume directory: resumes from the newest "
@@ -355,6 +387,8 @@ def main(argv=None) -> int:
     ev.add_argument("--tiny", action="store_true", help="alias for --model tiny")
     ev.add_argument("--cpu-devices", type=int, default=0)
     ev.add_argument("--ckpt-dir", default="", help="restore params from this checkpoint")
+    ev.add_argument("--ema", action="store_true",
+                    help="evaluate the checkpoint's EMA weights (train --ema-decay)")
 
     bn = sub.add_parser(
         "bench", help="headline throughput benchmark (extra args pass through)"

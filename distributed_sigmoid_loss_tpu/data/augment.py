@@ -101,7 +101,9 @@ def color_jitter(
     contrast: float = 0.4,
     saturation: float = 0.4,
 ) -> jax.Array:
-    """Per-sample brightness/contrast/saturation jitter (factors ~ U(1±x))."""
+    """Per-sample brightness/contrast/saturation jitter (factors ~ U(1±x)),
+    clamped back to [0, 1] after each op (torchvision ColorJitter semantics —
+    inputs are [0, 1] floats)."""
     b = images.shape[0]
     kb, kc, ks = jax.random.split(key, 3)
 
@@ -110,11 +112,11 @@ def color_jitter(
             k, (b, 1, 1, 1), minval=1.0 - amount, maxval=1.0 + amount
         )
 
-    out = images * factors(kb, brightness)
+    out = jnp.clip(images * factors(kb, brightness), 0.0, 1.0)
     mean = out.mean(axis=(1, 2, 3), keepdims=True)
-    out = (out - mean) * factors(kc, contrast) + mean
+    out = jnp.clip((out - mean) * factors(kc, contrast) + mean, 0.0, 1.0)
     gray = out.mean(axis=-1, keepdims=True)
-    out = (out - gray) * factors(ks, saturation) + gray
+    out = jnp.clip((out - gray) * factors(ks, saturation) + gray, 0.0, 1.0)
     return out
 
 
@@ -143,7 +145,13 @@ def augment_batch(
 ) -> jax.Array:
     """The standard contrastive train transform: random resized crop + flip
     (+ optional color jitter), then SigLIP normalization. ``train=False`` is the
-    eval transform: plain resize + normalize. Jittable; fixed output shapes."""
+    eval transform: plain resize + normalize. Jittable; fixed output shapes.
+
+    Integer input is [0, 255] pixels, converted to [0, 1] floats HERE — the
+    crop/resize would otherwise produce float [0, 255] values that skip
+    ``normalize``'s own integer handling."""
+    if not jnp.issubdtype(images.dtype, jnp.floating):
+        images = images.astype(jnp.float32) / 255.0
     if not train:
         b, h, w, c = images.shape
         resized = jax.image.resize(images, (b, out_size, out_size, c), "bilinear")

@@ -17,3 +17,8 @@ from distributed_sigmoid_loss_tpu.train.resilience import (  # noqa: F401
     save_step,
     train_resilient,
 )
+from distributed_sigmoid_loss_tpu.train.ema import (  # noqa: F401
+    ema_decay_schedule,
+    init_ema,
+    update_ema,
+)

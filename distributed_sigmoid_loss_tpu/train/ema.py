@@ -1,0 +1,45 @@
+"""Exponential moving average of params — the weights SigLIP-style models eval with.
+
+Pure-pytree implementation (no optax wrapper state to thread): the EMA tree mirrors
+the param tree leaf-for-leaf, so it inherits the params' shardings under jit and
+checkpoints like any other pytree. The decay warmup (``min(decay, (1+t)/(10+t))``)
+is the standard TF/scenic ramp that keeps early EMA from being dominated by the
+random init.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_ema", "update_ema", "ema_decay_schedule"]
+
+
+def init_ema(params):
+    """EMA state = a copy of the params (same shapes, dtypes, shardings)."""
+    return jax.tree.map(jnp.asarray, params)
+
+
+def ema_decay_schedule(step, decay: float = 0.9999):
+    """Warmed-up decay: ``min(decay, (1 + step) / (10 + step))`` — 0.1 at step 0
+    rising to ``decay``, so the average forgets the random init quickly."""
+    step = jnp.asarray(step, jnp.float32)
+    return jnp.minimum(decay, (1.0 + step) / (10.0 + step))
+
+
+def update_ema(ema, params, step=None, decay: float = 0.9999):
+    """One EMA update: ``ema = d * ema + (1 - d) * params``.
+
+    With ``step`` given, ``d`` follows :func:`ema_decay_schedule`; otherwise the
+    constant ``decay``. Call after the optimizer update, inside the jitted step.
+    """
+    d = ema_decay_schedule(step, decay) if step is not None else decay
+
+    def one(e, p):
+        # Cast the decay into the leaf dtype: a float32 `d` would silently
+        # promote bf16 EMA leaves, breaking the same-dtype invariant (and any
+        # scan carry / checkpoint-restore target built from init_ema).
+        df = jnp.asarray(d, e.dtype)
+        return df * e + (jnp.asarray(1.0, e.dtype) - df) * p.astype(e.dtype)
+
+    return jax.tree.map(one, ema, params)

@@ -39,17 +39,46 @@ __all__ = [
 
 
 class TrainState(train_state.TrainState):
-    pass
+    """Flax train state + optional EMA of the params (``ema=None`` = disabled;
+    as a pytree-None it adds no leaves, so states without EMA checkpoint and
+    shard exactly as before)."""
+
+    ema: Any = None
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    """AdamW + linear warmup → cosine decay + global-norm clipping."""
-    schedule = optax.warmup_cosine_decay_schedule(
-        init_value=0.0,
-        peak_value=cfg.learning_rate,
-        warmup_steps=cfg.warmup_steps,
-        decay_steps=cfg.total_steps,
-    )
+    """AdamW + global-norm clipping, LR per ``cfg.schedule`` (linear warmup then
+    cosine decay / inverse-sqrt / constant)."""
+    # warmup_steps=0 means NO warmup (full LR at step 0) in every branch;
+    # the sqrt timescale clamps to 1 only to avoid a 0/0, not to re-add warmup.
+    warmup = cfg.warmup_steps
+    timescale = max(warmup, 1)
+    if cfg.schedule == "warmup_cosine":
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.learning_rate,
+            warmup_steps=warmup,
+            decay_steps=cfg.total_steps,
+        )
+    elif cfg.schedule == "rsqrt":
+        # peak / sqrt(t / warmup) for t > warmup — continuous at the peak and
+        # independent of total_steps (the paper's open-ended pretraining choice).
+        def schedule(step):
+            step = jnp.asarray(step, jnp.float32)
+            warm = cfg.learning_rate * step / timescale
+            decay = cfg.learning_rate * jnp.sqrt(
+                timescale / jnp.maximum(step, timescale)
+            )
+            return jnp.where(step < warmup, warm, decay)
+    elif cfg.schedule == "constant":
+        def schedule(step):
+            step = jnp.asarray(step, jnp.float32)
+            warm_factor = (
+                jnp.minimum(step / warmup, 1.0) if warmup > 0 else jnp.ones_like(step)
+            )
+            return cfg.learning_rate * warm_factor
+    else:
+        raise ValueError(f"unknown schedule: {cfg.schedule!r}")
     return optax.chain(
         optax.clip_by_global_norm(1.0),
         optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
@@ -147,11 +176,14 @@ def create_train_state(
     mesh: Mesh,
     zero1: bool = False,
     axis_name: str = "dp",
+    ema: bool = False,
 ) -> TrainState:
     """Initialize a full train state, every leaf committed to the mesh.
 
     ``zero1=True`` shards the optimizer state over ``axis_name`` (ZeRO-1); pass
     the same flag to :func:`make_train_step` so the step keeps it sharded.
+    ``ema=True`` adds an EMA copy of the params (pair with ``ema_decay`` on
+    :func:`make_train_step`).
     """
     params = init_params(rng, model, sample_batch, mesh)
 
@@ -164,6 +196,10 @@ def create_train_state(
             state = state.replace(
                 opt_state=zero1_constrain(state.opt_state, mesh, axis_name)
             )
+        if ema:
+            from distributed_sigmoid_loss_tpu.train.ema import init_ema
+
+            state = state.replace(ema=init_ema(p))
         return state
 
     return jax.jit(create)(params)
@@ -175,6 +211,7 @@ def make_train_step(
     loss_cfg: LossConfig = LossConfig(),
     accum_steps: int = 1,
     zero1: bool = False,
+    ema_decay: float | None = None,
 ):
     """Build the jitted ``(state, batch) -> (state, metrics)`` step.
 
@@ -190,6 +227,10 @@ def make_train_step(
 
     ``zero1=True`` keeps the optimizer state sharded over ``dp`` (ZeRO-1, see
     :func:`zero1_constrain`); create the state with the same flag.
+
+    ``ema_decay`` maintains the params' exponential moving average in
+    ``state.ema`` (decay warmed up per ``ema_decay_schedule``); create the state
+    with ``ema=True``.
     """
     axis = loss_cfg.axis_name
     precision = _precision(loss_cfg.precision)
@@ -288,6 +329,19 @@ def make_train_step(
             # consumes reduce-scattered grads and all-gathers the param delta.
             state = state.replace(
                 opt_state=zero1_constrain(state.opt_state, mesh, axis)
+            )
+        if ema_decay is not None:
+            if state.ema is None:
+                raise ValueError(
+                    "ema_decay is set but state.ema is None — create the train "
+                    "state with create_train_state(..., ema=True)"
+                )
+            from distributed_sigmoid_loss_tpu.train.ema import update_ema
+
+            state = state.replace(
+                ema=update_ema(
+                    state.ema, state.params, step=state.step, decay=ema_decay
+                )
             )
         metrics = {
             "loss": loss,

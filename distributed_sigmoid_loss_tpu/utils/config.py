@@ -156,3 +156,7 @@ class TrainConfig:
     b1: float = 0.9
     b2: float = 0.95
     global_batch: int = 4096
+    # "warmup_cosine" (open_clip default), "rsqrt" (the SigLIP paper's inverse
+    # sqrt with linear warmup — total_steps-free, for open-ended pretraining),
+    # or "constant" (after warmup).
+    schedule: Literal["warmup_cosine", "rsqrt", "constant"] = "warmup_cosine"

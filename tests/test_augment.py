@@ -94,3 +94,20 @@ def test_normalize_uint8_pixels():
     imgs = jnp.asarray([[[[0, 128, 255]]]], jnp.uint8)
     out = np.asarray(normalize(imgs))
     np.testing.assert_allclose(out[0, 0, 0], [-1.0, 0.00392, 1.0], atol=1e-3)
+
+
+def test_augment_batch_uint8_pixels_normalized_range():
+    """uint8 input through the FULL transform must land in [-1, 1] — the int
+    conversion happens before crop/resize, not only inside normalize."""
+    imgs = jnp.full((2, 24, 24, 3), 200, jnp.uint8)
+    for train in (True, False):
+        out = np.asarray(augment_batch(jax.random.key(0), imgs, 16, train=train))
+        assert out.min() >= -1.0 - 1e-5 and out.max() <= 1.0 + 1e-5, (
+            train, out.min(), out.max())
+        np.testing.assert_allclose(out, (200 / 255 - 0.5) / 0.5, atol=1e-3)
+
+
+def test_color_jitter_clamps_to_unit_range():
+    imgs = jnp.ones((4, 8, 8, 3), jnp.float32)  # all-white: brightness > 1 must clamp
+    out = np.asarray(color_jitter(jax.random.key(0), imgs, 0.5, 0.5, 0.5))
+    assert out.min() >= 0.0 and out.max() <= 1.0

@@ -65,6 +65,40 @@ def test_train_then_eval_checkpoint_roundtrip(tmp_path):
     assert "zeroshot_top@1" in proc.stdout
 
 
+def test_train_ema_then_eval_both_weight_sets(tmp_path):
+    """A checkpoint written with --ema-decay evals both ways: plain params
+    (auto-detected EMA-shaped restore target) and --ema (the EMA weights)."""
+    ck = str(tmp_path / "ck")
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "3", "--batch", "16",
+         "--ema-decay", "0.9", "--ckpt-dir", ck, "--ckpt-every", "2"]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for extra, tag in ([], "(params)"), (["--ema"], "(ema)"):
+        proc = _run(
+            ["eval", "--cpu-devices", "8", "--tiny", "--batch", "16",
+             "--classes", "4", "--ckpt-dir", ck, *extra]
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert tag in proc.stderr
+        assert "zeroshot_top@1" in proc.stdout
+
+
+def test_eval_ema_flag_without_ema_checkpoint(tmp_path):
+    ck = str(tmp_path / "ck")
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "2", "--batch", "16",
+         "--ckpt-dir", ck, "--ckpt-every", "2"]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    proc = _run(
+        ["eval", "--cpu-devices", "8", "--tiny", "--batch", "16",
+         "--ckpt-dir", ck, "--ema"]
+    )
+    assert proc.returncode == 2
+    assert "no EMA weights" in proc.stderr
+
+
 def test_eval_missing_checkpoint_clear_error(tmp_path):
     proc = _run(
         ["eval", "--cpu-devices", "8", "--tiny", "--batch", "16",

@@ -1,0 +1,125 @@
+"""EMA params and LR schedule options."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.train.ema import (
+    ema_decay_schedule,
+    init_ema,
+    update_ema,
+)
+from distributed_sigmoid_loss_tpu.train.train_step import make_optimizer
+from distributed_sigmoid_loss_tpu.utils.config import TrainConfig
+
+
+def test_ema_converges_to_constant_params():
+    params = {"w": jnp.ones((4,)) * 2.0, "b": jnp.asarray(-1.0)}
+    ema = init_ema({"w": jnp.zeros((4,)), "b": jnp.asarray(0.0)})
+    for step in range(200):
+        ema = update_ema(ema, params, step=step, decay=0.9)
+    np.testing.assert_allclose(np.asarray(ema["w"]), 2.0, rtol=1e-4)
+    np.testing.assert_allclose(float(ema["b"]), -1.0, rtol=1e-4)
+
+
+def test_ema_decay_warmup_ramp():
+    assert float(ema_decay_schedule(0, 0.9999)) == pytest.approx(0.1)
+    assert float(ema_decay_schedule(90, 0.9999)) == pytest.approx(0.91)
+    assert float(ema_decay_schedule(10**7, 0.9999)) == pytest.approx(0.9999)
+
+
+def test_ema_is_jittable_and_tree_shaped():
+    params = {"a": jnp.ones((2, 3)), "nested": {"b": jnp.zeros(())}}
+    ema = init_ema(params)
+    step_fn = jax.jit(lambda e, p, s: update_ema(e, p, step=s))
+    out = step_fn(ema, params, 5)
+    assert jax.tree.structure(out) == jax.tree.structure(params)
+
+
+def test_rsqrt_schedule_shape():
+    cfg = TrainConfig(learning_rate=1e-3, warmup_steps=100, schedule="rsqrt")
+    tx = make_optimizer(cfg)
+    params = {"w": jnp.zeros(())}
+    state = tx.init(params)
+    # Track the effective step size of a unit gradient over time: warmup rises,
+    # then decays ~ 1/sqrt(t), never hitting zero.
+    lrs = []
+    for _ in range(300):
+        updates, state = tx.update({"w": jnp.asarray(1.0)}, state, params)
+        lrs.append(-float(updates["w"]))
+    assert lrs[10] < lrs[50] < lrs[99]  # warmup rising
+    assert lrs[150] > lrs[299] > 0  # decaying but positive
+    np.testing.assert_allclose(lrs[299] / lrs[120], np.sqrt(121 / 300), rtol=0.1)
+
+
+def test_constant_schedule_flat_after_warmup():
+    cfg = TrainConfig(learning_rate=1e-3, warmup_steps=10, schedule="constant")
+    tx = make_optimizer(cfg)
+    params = {"w": jnp.zeros(())}
+    state = tx.init(params)
+    lrs = []
+    for _ in range(50):
+        updates, state = tx.update({"w": jnp.asarray(1.0)}, state, params)
+        lrs.append(-float(updates["w"]))
+    assert lrs[2] < lrs[8]  # warming up
+    np.testing.assert_allclose(lrs[20], lrs[49], rtol=1e-5)
+
+
+def test_unknown_schedule_raises():
+    import dataclasses
+
+    cfg = dataclasses.replace(TrainConfig(), schedule="bogus")
+    with pytest.raises(ValueError, match="unknown schedule"):
+        make_optimizer(cfg)
+
+
+def test_ema_in_train_state_end_to_end(tmp_path):
+    """EMA wired through create_train_state/make_train_step: updated each step,
+    dtype-stable, checkpointable; missing ema with ema_decay raises clearly."""
+    from distributed_sigmoid_loss_tpu.data.synthetic import SyntheticImageText
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_train_step,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig, SigLIPConfig
+
+    mesh = make_mesh(8)
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+    first = next(iter(SyntheticImageText(cfg, 16)))
+    state = create_train_state(jax.random.key(0), model, tx, first, mesh, ema=True)
+    ema0 = jax.tree.map(np.asarray, state.ema)
+    step, shardings = make_train_step(
+        model, mesh, LossConfig(variant="ring"), ema_decay=0.9
+    )
+    batch = jax.device_put(first, shardings)
+    for _ in range(2):
+        state, _ = step(state, batch)
+    # EMA moved off the init and tracks params' dtype/structure.
+    moved = jax.tree.leaves(
+        jax.tree.map(lambda a, b: np.any(a != np.asarray(b)), ema0, state.ema)
+    )
+    assert any(moved)
+    jax.tree.map(
+        lambda e, p: (_ for _ in ()).throw(AssertionError((e.dtype, p.dtype)))
+        if e.dtype != p.dtype else None,
+        state.ema, state.params,
+    )
+    # Checkpoint roundtrip includes the EMA leaves.
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, state)
+    restored = restore_checkpoint(path, state)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state.ema, restored.ema,
+    )
+    # Clear error when ema_decay is set but the state has no ema.
+    bare = create_train_state(jax.random.key(0), model, tx, first, mesh)
+    with pytest.raises(ValueError, match="ema=True"):
+        step(bare, batch)
