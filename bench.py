@@ -223,11 +223,18 @@ def main():
     peak = PEAK_BF16_TFLOPS.get(device_kind)
     model_flops_per_pair = 3.0 * model_forward_flops_per_pair(cfg)
     achieved_model_tflops = model_flops_per_pair * pairs_per_sec_per_chip / 1e12
+    # The published A100 ballpark is a ViT-B/16 number; for other models the
+    # comparable reference is the same-MFU A100 rate, i.e. scaled by the FLOPs
+    # ratio — otherwise vs_baseline for l14/so400m compares throughput of
+    # different-sized models.
+    flops_b16 = model_forward_flops_per_pair(SigLIPConfig.b16())
+    a100_ref = A100_REF_PAIRS_PER_SEC * flops_b16 / model_forward_flops_per_pair(cfg)
     record = {
         "metric": f"siglip_vit{args.model}_train_pairs_per_sec_per_chip",
         "value": round(pairs_per_sec_per_chip, 2),
         "unit": "pairs/s/chip",
-        "vs_baseline": round(pairs_per_sec_per_chip / A100_REF_PAIRS_PER_SEC, 3),
+        "vs_baseline": round(pairs_per_sec_per_chip / a100_ref, 3),
+        "a100_ref_pairs_per_sec": round(a100_ref, 1),
         "model": args.model,
         "per_chip_batch": args.batch,
         "global_batch": global_b,

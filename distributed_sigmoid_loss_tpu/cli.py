@@ -231,6 +231,13 @@ def cmd_eval(args) -> int:
     from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
     from distributed_sigmoid_loss_tpu.train import init_params
 
+    if args.ema and not args.ckpt_dir:
+        print(
+            "--ema requires --ckpt-dir (EMA weights live in a train checkpoint; "
+            "a fresh model has none)",
+            file=sys.stderr,
+        )
+        return 2
     cfg = _model_config(args)
     mesh = make_mesh()
     model = SigLIP(cfg)
@@ -255,32 +262,30 @@ def cmd_eval(args) -> int:
         )
         try:
             restored = restore_latest(args.ckpt_dir, state)
-        except Exception as e:
-            if "ema" not in str(e).lower():
-                raise
+        except Exception as first_err:
+            # The checkpoint's EMA-shapedness may differ from the request; retry
+            # with the other target shape. If that fails too, the problem is NOT
+            # EMA (wrong --model, corrupt checkpoint, ...) — surface the
+            # ORIGINAL error rather than guessing from message text.
+            try:
+                alt = create_train_state(
+                    jax.random.key(0), model, tx, batch, mesh, ema=not args.ema
+                )
+                restored = restore_latest(args.ckpt_dir, alt)
+            except Exception:
+                raise first_err
             if args.ema:
-                # Target had an ema subtree but the checkpoint does not.
+                # The bare-shaped retry succeeded: the checkpoint has no EMA.
                 print(
                     f"--ema requested but the checkpoint at {args.ckpt_dir} has "
                     f"no EMA weights (train with --ema-decay)",
                     file=sys.stderr,
                 )
                 return 2
-            state = create_train_state(
-                jax.random.key(0), model, tx, batch, mesh, ema=True
-            )
-            restored = restore_latest(args.ckpt_dir, state)
         if restored is None:
             print(f"no checkpoint found under {args.ckpt_dir}", file=sys.stderr)
             return 2
         state, step = restored
-        if args.ema and state.ema is None:
-            print(
-                f"--ema requested but the checkpoint at {args.ckpt_dir} has no "
-                f"EMA weights (train with --ema-decay)",
-                file=sys.stderr,
-            )
-            return 2
         which = "ema" if args.ema else "params"
         print(f"restored step {step} ({which}) from {args.ckpt_dir}", file=sys.stderr)
         params = state.ema if args.ema else state.params

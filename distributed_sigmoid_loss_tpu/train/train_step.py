@@ -322,7 +322,8 @@ def make_train_step(
 
     def step(state: TrainState, batch: dict):
         loss, lp, grads = grads_and_metrics(state.params, batch)
-        state = state.apply_gradients(grads=grads)
+        prev_step = state.step  # apply_gradients increments; EMA warmup wants
+        state = state.apply_gradients(grads=grads)  # the 0-based update index
         if zero1:
             # Re-pin the new optimizer state to its ZeRO-1 placement: XLA
             # propagates the constraint into the adam update, which therefore
@@ -340,7 +341,7 @@ def make_train_step(
 
             state = state.replace(
                 ema=update_ema(
-                    state.ema, state.params, step=state.step, decay=ema_decay
+                    state.ema, state.params, step=prev_step, decay=ema_decay
                 )
             )
         metrics = {

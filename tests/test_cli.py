@@ -174,3 +174,26 @@ def test_example_delegates_to_cli():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "mesh:" in proc.stderr
+
+
+def test_eval_ema_requires_ckpt_dir():
+    proc = _run(["eval", "--cpu-devices", "8", "--tiny", "--ema"], timeout=120)
+    assert proc.returncode == 2
+    assert "requires --ckpt-dir" in proc.stderr
+
+
+def test_eval_wrong_model_surfaces_real_error(tmp_path):
+    """A --model mismatch must raise the shape-mismatch error, not be
+    misreported as a missing-EMA problem."""
+    ck = str(tmp_path / "ck")
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "2", "--batch", "16",
+         "--ema-decay", "0.9", "--ckpt-dir", ck, "--ckpt-every", "2"]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    proc = _run(
+        ["eval", "--cpu-devices", "8", "--model", "b16", "--batch", "16",
+         "--ckpt-dir", ck, "--ema"], timeout=420,
+    )
+    assert proc.returncode not in (0, 2), proc.stderr[-500:]
+    assert "no EMA weights" not in proc.stderr
