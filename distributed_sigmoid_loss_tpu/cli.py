@@ -223,7 +223,6 @@ def cmd_eval(args) -> int:
     from distributed_sigmoid_loss_tpu.data import SyntheticImageText, put_batch
     from distributed_sigmoid_loss_tpu.data.tokenizer import ByteTokenizer
     from distributed_sigmoid_loss_tpu.eval import (
-        classifier_weights,
         retrieval_metrics,
         zeroshot_metrics,
     )
@@ -302,22 +301,31 @@ def cmd_eval(args) -> int:
 
     # Zero-shot classification demo: class prompts through the byte tokenizer and
     # text tower -> prompt-ensembled classifier; synthetic integer labels.
+    from functools import partial
+
+    from distributed_sigmoid_loss_tpu.eval import build_classifier
+
     tok = ByteTokenizer()
     n_classes = args.classes
-    # Class name first: short context lengths (tiny config: 8 tokens) would
-    # truncate a trailing class name out of every prompt, collapsing all
-    # classes onto identical token rows.
-    templates = ["{} photo.", "{} image."]
-    prompts = [t.format(f"c{c}") for c in range(n_classes) for t in templates]
-    if cfg.text.vocab_size >= tok.vocab_size:
-        tokens = jnp.asarray(tok(prompts, cfg.text.context_length))
-    else:  # tiny config: fold byte ids into the toy vocab (demo only; modulo
-        # keeps distinct prompts distinct, where clamping would collapse them
-        # all to the max id and make every class tie)
-        tokens = jnp.asarray(tok(prompts, cfg.text.context_length) % cfg.text.vocab_size)
-    ztxt_classes = model.apply({"params": params}, tokens, method=SigLIP.encode_text)
-    classifier = classifier_weights(
-        ztxt_classes.reshape(n_classes, len(templates), -1)
+
+    def tokenize(texts, length):
+        ids = tok(texts, length)
+        if cfg.text.vocab_size < tok.vocab_size:
+            # Tiny config: fold byte ids into the toy vocab (demo only; modulo
+            # keeps distinct prompts distinct, where clamping would collapse
+            # them all to the max id and make every class tie).
+            ids = ids % cfg.text.vocab_size
+        return ids
+
+    classifier = build_classifier(
+        partial(model.apply, {"params": params}, method=SigLIP.encode_text),
+        # Class name first: short context lengths (tiny config: 8 tokens) would
+        # truncate a trailing class name out of every prompt, collapsing all
+        # classes onto identical token rows.
+        [f"c{c}" for c in range(n_classes)],
+        tokenize,
+        cfg.text.context_length,
+        templates=("{} photo.", "{} image."),
     )
     rng = np.random.default_rng(0)
     labels = jnp.asarray(

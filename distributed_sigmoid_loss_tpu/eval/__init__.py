@@ -4,6 +4,8 @@ from distributed_sigmoid_loss_tpu.eval.retrieval import (
     retrieval_ranks,
 )
 from distributed_sigmoid_loss_tpu.eval.zeroshot import (
+    CLIP_TEMPLATES,
+    build_classifier,
     classifier_weights,
     classify_ranks,
     zeroshot_metrics,
@@ -13,6 +15,8 @@ __all__ = [
     "recall_at_k",
     "retrieval_metrics",
     "retrieval_ranks",
+    "CLIP_TEMPLATES",
+    "build_classifier",
     "classifier_weights",
     "classify_ranks",
     "zeroshot_metrics",

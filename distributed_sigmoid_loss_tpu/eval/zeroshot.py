@@ -23,7 +23,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import l2_normalize
 from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
 
-__all__ = ["classifier_weights", "classify_ranks", "zeroshot_metrics"]
+__all__ = [
+    "classifier_weights",
+    "classify_ranks",
+    "zeroshot_metrics",
+    "build_classifier",
+    "CLIP_TEMPLATES",
+]
+
+# A compact prompt-ensemble set (the CLIP/SigLIP eval recipe uses ~80 templates;
+# these seven carry most of the ensemble gain and keep eval cheap — callers pass
+# their own list for the full set). The class name sits LATE in each template:
+# with a short context_length the tokenizer truncates it away and every class
+# collapses onto identical tokens — use name-first templates ("{} photo.") when
+# context_length cannot hold the full prompt.
+CLIP_TEMPLATES = (
+    "a photo of a {}.",
+    "a photo of the {}.",
+    "a bad photo of a {}.",
+    "a photo of many {}.",
+    "a close-up photo of a {}.",
+    "a black and white photo of a {}.",
+    "an illustration of a {}.",
+)
 
 
 def classifier_weights(class_text_embeddings: jax.Array) -> jax.Array:
@@ -32,6 +54,40 @@ def classifier_weights(class_text_embeddings: jax.Array) -> jax.Array:
     re-normalize (the CLIP/SigLIP prompt-ensembling recipe)."""
     z = l2_normalize(class_text_embeddings)
     return l2_normalize(jnp.mean(z, axis=1))
+
+
+def build_classifier(
+    encode_text,
+    class_names,
+    tokenizer,
+    context_length: int,
+    templates=CLIP_TEMPLATES,
+    batch_size: int = 1024,
+) -> jax.Array:
+    """Class names → (n_classes, d) prompt-ensembled classifier.
+
+    ``encode_text`` is any ``tokens -> (n, d) embeddings`` callable (e.g.
+    ``partial(model.apply, {"params": params}, method=SigLIP.encode_text)``);
+    ``tokenizer`` is the ``data.tokenizer`` interface (``(texts, length) -> ids``).
+    Prompts are encoded in fixed-size padded batches so one jitted shape serves
+    any class count. Template caveat: make sure ``context_length`` holds the
+    whole prompt — a truncated-away class name collapses all classes onto
+    identical tokens (put the name first in short-context setups).
+    """
+    prompts = [t.format(name) for name in class_names for t in templates]
+    tokens = jnp.asarray(tokenizer(prompts, context_length))
+    # Small prompt sets take one exactly-sized chunk (padding to a large
+    # batch_size would waste a ~batch_size/n_prompts x bigger forward).
+    batch_size = min(batch_size, tokens.shape[0])
+    chunks = []
+    for start in range(0, tokens.shape[0], batch_size):
+        chunk = tokens[start : start + batch_size]
+        pad = batch_size - chunk.shape[0]
+        if pad:  # only the final chunk is short; keep the jitted shape fixed
+            chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
+        chunks.append(encode_text(chunk))
+    z = jnp.concatenate(chunks)[: len(prompts)]  # drops the final chunk's padding
+    return classifier_weights(z.reshape(len(class_names), len(templates), -1))
 
 
 def classify_ranks(zimg: jax.Array, classifier: jax.Array, labels: jax.Array) -> jax.Array:

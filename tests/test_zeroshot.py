@@ -92,3 +92,48 @@ def test_ties_resolve_optimistically():
     zimg = jnp.asarray([[1.0, 0.0]], jnp.float32)
     labels = jnp.asarray([1], jnp.int32)
     assert int(classify_ranks(zimg, classifier, labels)[0]) == 0
+
+
+def test_build_classifier_end_to_end():
+    """Names -> tokenizer -> text tower -> ensembled classifier, including the
+    multi-chunk path (batch_size smaller than the prompt count)."""
+    import dataclasses
+    from functools import partial
+
+    from distributed_sigmoid_loss_tpu.data.tokenizer import ByteTokenizer
+    from distributed_sigmoid_loss_tpu.eval.zeroshot import build_classifier
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+    tok = ByteTokenizer()
+    cfg = SigLIPConfig.tiny_test()
+    cfg = dataclasses.replace(
+        cfg, text=dataclasses.replace(cfg.text, vocab_size=tok.vocab_size)
+    )
+    model = SigLIP(cfg)
+    names = [f"c{i}" for i in range(5)]
+    templates = ("{} photo.", "{} image.", "a {}.")
+    sample_tokens = jnp.asarray(tok(["x"], cfg.text.context_length))
+    sample_images = jnp.zeros(
+        (1, cfg.vision.image_size, cfg.vision.image_size, 3), jnp.float32
+    )
+    params = model.init(jax.random.key(0), sample_images, sample_tokens)["params"]
+    import flax.linen as nn
+
+    params = nn.meta.unbox(params)
+    encode = partial(model.apply, {"params": params}, method=SigLIP.encode_text)
+
+    w_chunked = build_classifier(
+        encode, names, tok, cfg.text.context_length, templates, batch_size=4
+    )
+    w_onego = build_classifier(
+        encode, names, tok, cfg.text.context_length, templates, batch_size=1024
+    )
+    assert w_chunked.shape == (5, cfg.text.embed_dim)
+    np.testing.assert_allclose(
+        np.asarray(jnp.linalg.norm(w_chunked, axis=-1)), 1.0, rtol=1e-5
+    )
+    # Chunking must not change the result (padding rows are dropped).
+    np.testing.assert_allclose(
+        np.asarray(w_chunked), np.asarray(w_onego), rtol=1e-5, atol=1e-6
+    )
