@@ -74,6 +74,10 @@ def build_classifier(
     whole prompt — a truncated-away class name collapses all classes onto
     identical tokens (put the name first in short-context setups).
     """
+    if not class_names:
+        raise ValueError("class_names must be non-empty")
+    if not templates:
+        raise ValueError("templates must be non-empty")
     prompts = [t.format(name) for name in class_names for t in templates]
     tokens = jnp.asarray(tokenizer(prompts, context_length))
     # Small prompt sets take one exactly-sized chunk (padding to a large

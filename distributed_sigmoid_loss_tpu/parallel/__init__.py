@@ -25,3 +25,8 @@ from distributed_sigmoid_loss_tpu.parallel.ulysses_attention import (  # noqa: F
     ulysses_self_attention,
     make_ulysses_attention,
 )
+from distributed_sigmoid_loss_tpu.parallel.pipeline import (  # noqa: F401
+    gpipe,
+    make_layer_stage_fn,
+    stack_stage_params,
+)

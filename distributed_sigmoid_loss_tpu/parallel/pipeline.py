@@ -1,0 +1,170 @@
+"""Pipeline parallelism: GPipe microbatch scheduling over a ``pp`` mesh axis.
+
+The reference has no pipeline layer (its towers are toy Linears,
+/root/reference/test_distributed_sigmoid_loss.py:71-76); this module is part of the
+beyond-reference scale story, alongside tensor (tp), sequence (sp), and data (dp)
+parallelism: deep towers whose layers don't fit one chip are split into S *stages*
+laid out along a ``pp`` mesh axis, and M microbatches stream through the stages in
+the classic GPipe schedule (S + M - 1 ticks, bubble fraction (S-1)/(S+M-1)).
+
+TPU-native design, not a port of torch.distributed.pipelining:
+
+- **One jitted SPMD program.** Every stage runs the same code under ``shard_map``;
+  "which stage am I" is ``lax.axis_index("pp")``, and stage-to-stage activation
+  transfer is a single ``ppermute`` ring hop per tick — the ICI-neighbour pattern
+  the fabric is built for. There are no per-stage processes, queues, or schedules.
+- **Stage-stacked parameters.** Stage s owns ``params[s]`` of a (S, ...)-stacked
+  pytree sharded over ``pp`` — with ``depth//S`` transformer layers per stage this
+  is exactly the ``nn.scan`` layer-stacked layout reshaped to (S, depth//S, ...),
+  so pipeline placement is a pure sharding annotation on the existing tree.
+- **Autodiff = the reverse schedule.** The backward pipeline (cotangents flowing
+  last-stage → first-stage) is the transpose of ``lax.scan`` + ``ppermute`` — jax
+  derives it; nothing hand-written, mirroring how the framework gets the
+  reference's ``NeighbourExchange.backward`` for free (collectives.py).
+- **Static shapes.** Warmup/drain bubbles run the stage on don't-care data and
+  mask the writes (``jnp.where``), keeping every tick identical for XLA.
+
+Composability: ``gpipe`` is manual over ``pp`` only (``axis_names={"pp"}``), so
+dp/tp axes of the same mesh keep working through GSPMD — batch stays dp-sharded,
+stage weights stay tp-sharded, and the pipeline only moves activations.
+
+Scope note: microbatch inputs/outputs are replicated over ``pp`` (each stage holds
+the (M, ...) buffer); at tower-activation sizes this costs M·|x| HBM per chip and
+keeps the schedule a pure scan. Streaming stage-0-resident inputs is a further
+memory optimization, not a semantics change.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_sigmoid_loss_tpu.parallel.collectives import pvary, ring_shift_right
+
+__all__ = [
+    "pipeline_axis",
+    "gpipe",
+    "stack_stage_params",
+    "make_layer_stage_fn",
+]
+
+pipeline_axis = "pp"
+
+
+def stack_stage_params(layer_params: Any, num_stages: int) -> Any:
+    """Reshape layer-stacked params (leaves ``(depth, ...)``) to stage-major
+    ``(num_stages, depth // num_stages, ...)`` — the layout :func:`gpipe` shards
+    over the ``pp`` axis. ``depth`` must divide evenly into stages."""
+
+    def reshape(leaf):
+        depth = leaf.shape[0]
+        if depth % num_stages:
+            raise ValueError(
+                f"depth {depth} does not divide into {num_stages} pipeline stages"
+            )
+        return leaf.reshape((num_stages, depth // num_stages) + leaf.shape[1:])
+
+    return jax.tree.map(reshape, layer_params)
+
+
+def make_layer_stage_fn(layer_apply: Callable[[Any, jax.Array], jax.Array]) -> Callable:
+    """Stage function applying a stack of identical layers sequentially.
+
+    ``layer_apply(layer_params, x) -> x`` is one layer (e.g.
+    ``lambda p, x: block.apply({"params": p}, x)``); the returned stage function
+    takes the stage's ``(layers_per_stage, ...)``-stacked params and scans the
+    layers — the inner-depth analogue of ``Encoder(scan_layers=True)``.
+    """
+
+    def stage_fn(stage_params, x):
+        def body(carry, p):
+            return layer_apply(p, carry), None
+
+        x, _ = lax.scan(body, x, stage_params)
+        return x
+
+    return stage_fn
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    mesh: Mesh,
+    axis_name: str = pipeline_axis,
+    checkpoint_stages: bool = False,
+) -> jax.Array:
+    """Run ``microbatches`` through ``num_stages`` pipelined stages; returns outputs.
+
+    Args:
+      stage_fn: ``(per_stage_params, x) -> y`` with ``y.shape == x.shape`` (a
+        residual-block stack; the equal-shape constraint is what lets one ring
+        buffer carry every stage boundary).
+      stage_params: pytree with leading stage axis ``S == mesh.shape[axis_name]``
+        on every leaf, sharded over ``axis_name`` (see :func:`stack_stage_params`).
+      microbatches: ``(M, mb, ...)`` array of M microbatches. Any M ≥ 1 works;
+        throughput-wise M ≫ S amortizes the (S-1)-tick bubble.
+      checkpoint_stages: rematerialize each stage call in the backward pipeline
+        (GPipe's standard activation-memory trade).
+
+    Returns:
+      ``(M, mb, ...)`` outputs of the full S-stage stack, replicated over ``pp``.
+    """
+    num_stages = mesh.shape[axis_name]
+    num_micro = microbatches.shape[0]
+    if checkpoint_stages:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    def device_fn(params, xs):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        stage = lax.axis_index(axis_name)
+        xs = pvary(xs, axis_name)
+        # Ring buffer carrying the stage boundary + the output accumulator
+        # (zeros_like the varying xs, so both are varying too).
+        act0 = jnp.zeros_like(xs[0])
+        out0 = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            act, out = carry
+            # Stage boundary hop: every stage sends its last activation right and
+            # receives its predecessor's. Stage 0's "received" slot is ignored in
+            # favor of the next microbatch feed.
+            received = ring_shift_right(act, axis_name)
+            feed = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, num_micro - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(stage == 0, feed, received)
+            y = stage_fn(params, x_in)
+            # The last stage finishes microbatch t-(S-1) at tick t; warmup ticks
+            # (t < S-1) write nowhere. Stage-0 re-feeds past M need no guard:
+            # they would reach the last stage only at tick M+S-1, past the scan.
+            out_idx = jnp.clip(t - (num_stages - 1), 0, num_micro - 1)
+            is_ready = (stage == num_stages - 1) & (t >= num_stages - 1)
+            out = jnp.where(
+                is_ready,
+                lax.dynamic_update_index_in_dim(out, y.astype(out.dtype), out_idx, 0),
+                out,
+            )
+            return (y, out), None
+
+        (_, out), _ = lax.scan(
+            tick, (act0, out0), jnp.arange(num_micro + num_stages - 1)
+        )
+        # Only the last stage holds real outputs; the masked psum replicates them
+        # to every stage (its transpose feeds cotangents back to the last stage).
+        return lax.psum(
+            jnp.where(stage == num_stages - 1, out, jnp.zeros_like(out)), axis_name
+        )
+
+    return jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        axis_names={axis_name},
+    )(stage_params, microbatches)
