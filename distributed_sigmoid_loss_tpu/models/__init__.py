@@ -2,6 +2,7 @@ from distributed_sigmoid_loss_tpu.models.towers import LinearTower, toy_tower_ap
 from distributed_sigmoid_loss_tpu.models.vit import ViT  # noqa: F401
 from distributed_sigmoid_loss_tpu.models.text import TextTransformer  # noqa: F401
 from distributed_sigmoid_loss_tpu.models.siglip import SigLIP  # noqa: F401
+from distributed_sigmoid_loss_tpu.models.moe import MoeMlp  # noqa: F401
 from distributed_sigmoid_loss_tpu.models.hf_import import (  # noqa: F401
     config_from_hf,
     params_from_hf,

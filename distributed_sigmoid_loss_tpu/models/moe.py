@@ -1,0 +1,158 @@
+"""Mixture-of-Experts MLP with expert parallelism over an ``ep`` mesh axis.
+
+The reference has no model layer at all (its towers are toy Linears,
+/root/reference/test_distributed_sigmoid_loss.py:71-76); MoE is part of this
+framework's beyond-reference scale story — the standard way to grow tower
+capacity without growing per-token FLOPs.
+
+TPU-native design (GShard/Switch, not a torch-style loop over experts):
+
+- **Dispatch is einsum, not gather.** Routing builds one-hot dispatch/combine
+  tensors and moves tokens with two (T,E,C)-shaped einsums — dense matmuls the
+  MXU executes directly, with no data-dependent shapes or scatter ops that would
+  defeat XLA. Capacity ``C`` is static: ``ceil(k·T/E · capacity_factor)``.
+- **Expert parallelism is a sharding annotation.** Expert kernels are stacked
+  ``(E, d, h)`` and partitioned over ``ep`` (composable with ``tp`` on the hidden
+  dim); under jit GSPMD turns the dispatch einsums into the all-to-alls that ship
+  token slots to their expert's chip — no hand-written comm, same recipe as the
+  tp all-reduces in models/transformer.py.
+- **Static drop semantics.** Tokens routed past a full expert buffer contribute
+  zero output (the residual connection carries them through unchanged) — the
+  schedule every tick is shape-identical, which is what keeps one compiled step.
+- **Router in f32.** Softmax over expert logits runs in float32 regardless of the
+  activation dtype (bf16 router logits visibly perturb top-k order); the expert
+  matmuls themselves stay in the model dtype.
+
+The load-balancing auxiliary loss (Switch Transformers eq. 4: ``E · Σ_e f_e·P_e``)
+is sown into the ``"intermediates"`` collection as ``"moe_aux_loss"``; training
+code pulls it with ``mutable=["intermediates"]`` and adds
+``moe_aux_weight · mean`` to the task loss (see train/train_step.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+# Mesh axis name for expert parallelism (mirrors TP_AXIS in transformer.py).
+EP_AXIS = "ep"
+
+__all__ = ["MoeMlp", "EP_AXIS"]
+
+
+class MoeMlp(nn.Module):
+    """Drop-in MoE replacement for the dense transformer ``Mlp``.
+
+    Args:
+      width: model dim d.
+      mlp_ratio: expert hidden dim = ``round(width * mlp_ratio)``.
+      num_experts: E, total experts (shard-count over ``ep`` divides this).
+      num_selected: k experts per token (1 = Switch, 2 = GShard-style top-2 with
+        renormalized gates).
+      capacity_factor: per-expert buffer slack over the perfectly-balanced
+        ``k·T/E`` load; tokens past the buffer are dropped (residual carries them).
+      dtype: activation dtype for the expert matmuls (router stays f32).
+    """
+
+    width: int
+    mlp_ratio: int | float
+    num_experts: int
+    dtype: Any
+    num_selected: int = 1
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x):
+        if self.num_selected not in (1, 2):
+            raise ValueError(f"num_selected must be 1 or 2, got {self.num_selected}")
+        if self.num_experts < 2:
+            raise ValueError(f"num_experts must be >= 2, got {self.num_experts}")
+        d, e, k = self.width, self.num_experts, self.num_selected
+        hidden = int(round(self.width * self.mlp_ratio))
+        *lead, d_in = x.shape
+        assert d_in == d, f"input dim {d_in} != width {d}"
+        tokens = 1
+        for n in lead:
+            tokens *= n
+        xt = x.reshape(tokens, d)
+
+        # --- Router (f32 end-to-end) ------------------------------------------
+        wr = self.param(
+            "router", nn.initializers.normal(0.02), (d, e), jnp.float32
+        )
+        logits = jnp.einsum("td,de->te", xt.astype(jnp.float32), wr)
+        probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+        gates, idx = jax.lax.top_k(probs, k)  # (T, k)
+        if k > 1:
+            gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+
+        # --- Capacity assignment ----------------------------------------------
+        # Slot positions via a cumulative count in choice-major order: every
+        # token's 1st choice outranks any token's 2nd choice (GShard's priority
+        # rule), and within a choice earlier tokens win — all static-shape.
+        capacity = min(
+            tokens, max(1, int(-(-k * tokens * self.capacity_factor // e)))
+        )
+        choice_onehot = jax.nn.one_hot(
+            jnp.swapaxes(idx, 0, 1), e, dtype=jnp.float32
+        )  # (k, T, E)
+        position = (
+            jnp.cumsum(choice_onehot.reshape(k * tokens, e), axis=0) - 1.0
+        ).reshape(k, tokens, e)
+        slot = jnp.sum(position * choice_onehot, axis=-1).astype(jnp.int32)  # (k, T)
+        keep = (slot < capacity).astype(jnp.float32)
+        slot_onehot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32) * keep[
+            ..., None
+        ]  # (k, T, C)
+        # (k, T, E, C) per-choice dispatch; choices land in disjoint slots so the
+        # sum over k is still one-hot per (E, C) slot.
+        dispatch = jnp.einsum("kte,ktc->ktec", choice_onehot, slot_onehot)
+        combine = jnp.einsum("tk,ktec->tec", gates.astype(jnp.float32),
+                             dispatch)  # gate-weighted
+        dispatch = jnp.sum(dispatch, axis=0)  # (T, E, C)
+
+        # --- Load-balancing auxiliary loss (Switch eq. 4) ---------------------
+        # f_e: fraction of tokens whose first choice is e; P_e: mean router prob.
+        first_choice = jax.nn.one_hot(idx[:, 0], e, dtype=jnp.float32)
+        aux = e * jnp.sum(
+            jnp.mean(first_choice, axis=0) * jnp.mean(probs, axis=0)
+        )
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        # --- Expert compute (model dtype; E sharded over ep) ------------------
+        wi = self.param(
+            "wi",
+            nn.with_partitioning(
+                nn.initializers.xavier_uniform(), (EP_AXIS, None, "tp")
+            ),
+            (e, d, hidden),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_partitioning(
+                nn.initializers.xavier_uniform(), (EP_AXIS, "tp", None)
+            ),
+            (e, hidden, d),
+            jnp.float32,
+        )
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(self.dtype), xt.astype(self.dtype)
+        )
+        # Same checkpoint tag as the dense Mlp (transformer.py): the save_hot /
+        # save_mlp remat policies keep the expert hidden activation, so backward
+        # recompute stops at the elementwise gelu for MoE blocks too.
+        hidden_act = checkpoint_name(
+            jnp.einsum("ecd,edh->ech", expert_in, wi.astype(self.dtype)),
+            "mlp_hidden",
+        )
+        h = nn.gelu(hidden_act, approximate=True)
+        expert_out = jnp.einsum("ech,ehd->ecd", h, wo.astype(self.dtype))
+        y = jnp.einsum(
+            "tec,ecd->td", combine.astype(self.dtype), expert_out
+        )
+        return y.reshape(*lead, d)

@@ -40,7 +40,9 @@ class TextTransformer(nn.Module):
             remat=cfg.remat, scan_layers=cfg.scan_layers, attn_impl=cfg.attn_impl,
             remat_policy=cfg.remat_policy,
             sp_axis=cfg.sequence_parallel_axis, sp_impl=cfg.sequence_parallel_impl,
-            causal=cfg.causal, name="encoder",
+            causal=cfg.causal, moe_experts=cfg.moe_experts,
+            moe_num_selected=cfg.moe_num_selected,
+            moe_capacity_factor=cfg.moe_capacity_factor, name="encoder",
         )(x)
 
         if cfg.pool == "map":

@@ -209,7 +209,10 @@ class Attention(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-LN transformer block."""
+    """Pre-LN transformer block. ``moe_experts > 0`` swaps the dense MLP for a
+    mixture-of-experts layer (models/moe.py) whose expert weights shard over the
+    ``ep`` mesh axis; the residual stream is unchanged, so MoE composes with
+    remat/scan/sp exactly like the dense block."""
 
     width: int
     num_heads: int
@@ -219,6 +222,9 @@ class Block(nn.Module):
     sp_impl: str = "ring"
     attn_impl: str = "auto"
     causal: bool = False
+    moe_experts: int = 0
+    moe_num_selected: int = 1
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x):
@@ -228,9 +234,18 @@ class Block(nn.Module):
             attn_impl=self.attn_impl, causal=self.causal,
             name="attn",
         )(nn.LayerNorm(dtype=self.dtype, name="ln1")(x))
-        x = x + Mlp(self.width, self.mlp_ratio, self.dtype, name="mlp")(
-            nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        )
+        if self.moe_experts > 0:
+            from distributed_sigmoid_loss_tpu.models.moe import MoeMlp
+
+            mlp = MoeMlp(
+                self.width, self.mlp_ratio, self.moe_experts, self.dtype,
+                num_selected=self.moe_num_selected,
+                capacity_factor=self.moe_capacity_factor,
+                name="moe",
+            )
+        else:
+            mlp = Mlp(self.width, self.mlp_ratio, self.dtype, name="mlp")
+        x = x + mlp(nn.LayerNorm(dtype=self.dtype, name="ln2")(x))
         return x
 
 
@@ -245,6 +260,9 @@ class _ScanBody(nn.Module):
     sp_impl: str = "ring"
     attn_impl: str = "auto"
     causal: bool = False
+    moe_experts: int = 0
+    moe_num_selected: int = 1
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, carry, _):
@@ -252,6 +270,9 @@ class _ScanBody(nn.Module):
             self.width, self.num_heads, self.mlp_ratio, self.dtype,
             sp_axis=self.sp_axis, sp_impl=self.sp_impl,
             attn_impl=self.attn_impl, causal=self.causal,
+            moe_experts=self.moe_experts,
+            moe_num_selected=self.moe_num_selected,
+            moe_capacity_factor=self.moe_capacity_factor,
             name="block",
         )(carry)
         return carry, None
@@ -275,9 +296,17 @@ class Encoder(nn.Module):
     sp_impl: str = "ring"
     attn_impl: str = "auto"
     causal: bool = False
+    moe_experts: int = 0
+    moe_num_selected: int = 1
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x):
+        moe_kw = dict(
+            moe_experts=self.moe_experts,
+            moe_num_selected=self.moe_num_selected,
+            moe_capacity_factor=self.moe_capacity_factor,
+        )
         if self.scan_layers:
             body_cls = _ScanBody
             if self.remat:
@@ -287,9 +316,10 @@ class Encoder(nn.Module):
                     policy=_remat_policy(self.remat_policy),
                 )
             # One set of stacked params, compiled once: lax.scan over depth.
+            # The sown MoE aux losses ride the scan with a leading depth axis.
             scanned = nn.scan(
                 body_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 length=self.depth,
                 metadata_params={nn.PARTITION_NAME: None},
@@ -297,7 +327,7 @@ class Encoder(nn.Module):
             x, _ = scanned(
                 self.width, self.num_heads, self.mlp_ratio, self.dtype,
                 sp_axis=self.sp_axis, sp_impl=self.sp_impl,
-                attn_impl=self.attn_impl, causal=self.causal,
+                attn_impl=self.attn_impl, causal=self.causal, **moe_kw,
                 name="blocks",
             )(x, None)
         else:
@@ -310,7 +340,7 @@ class Encoder(nn.Module):
                 x = block_cls(
                     self.width, self.num_heads, self.mlp_ratio, self.dtype,
                     sp_axis=self.sp_axis, sp_impl=self.sp_impl,
-                    attn_impl=self.attn_impl, causal=self.causal,
+                    attn_impl=self.attn_impl, causal=self.causal, **moe_kw,
                     name=f"block{i}",
                 )(x)
         return nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)

@@ -47,7 +47,9 @@ class ViT(nn.Module):
         x = Encoder(
             cfg.width, cfg.depth, cfg.num_heads, cfg.mlp_ratio, dtype,
             remat=cfg.remat, scan_layers=cfg.scan_layers, attn_impl=cfg.attn_impl,
-            remat_policy=cfg.remat_policy, name="encoder",
+            remat_policy=cfg.remat_policy, moe_experts=cfg.moe_experts,
+            moe_num_selected=cfg.moe_num_selected,
+            moe_capacity_factor=cfg.moe_capacity_factor, name="encoder",
         )(x)
 
         if cfg.pool == "map":

@@ -212,6 +212,7 @@ def make_train_step(
     accum_steps: int = 1,
     zero1: bool = False,
     ema_decay: float | None = None,
+    moe_aux_weight: float | None = None,
 ):
     """Build the jitted ``(state, batch) -> (state, metrics)`` step.
 
@@ -231,6 +232,11 @@ def make_train_step(
     ``ema_decay`` maintains the params' exponential moving average in
     ``state.ema`` (decay warmed up per ``ema_decay_schedule``); create the state
     with ``ema=True``.
+
+    ``moe_aux_weight`` (use with ``moe_experts > 0`` towers) adds that weight
+    times the mean of the routers' sown load-balancing losses (models/moe.py) to
+    the task loss; without it MoE still trains but routing may collapse onto few
+    experts.
     """
     axis = loss_cfg.axis_name
     precision = _precision(loss_cfg.precision)
@@ -264,18 +270,47 @@ def make_train_step(
     )
 
     def loss_fn(params, batch):
-        zimg, ztxt, lp = model.apply(
-            {"params": params}, batch["images"], batch["tokens"]
-        )
+        if moe_aux_weight is None:
+            zimg, ztxt, lp = model.apply(
+                {"params": params}, batch["images"], batch["tokens"]
+            )
+            aux = jnp.zeros(())
+        else:
+            (zimg, ztxt, lp), variables = model.apply(
+                {"params": params}, batch["images"], batch["tokens"],
+                mutable=["intermediates"],
+            )
+            # Mean over every sown router aux scalar (scanned encoders sow one
+            # (depth,) leaf per tower; unrolled ones sow per-layer scalars).
+            # Filter by the sow name so other intermediates never leak into the
+            # objective.
+            flat = jax.tree_util.tree_flatten_with_path(
+                variables.get("intermediates", {})
+            )[0]
+            leaves = [
+                leaf
+                for path, leaf in flat
+                if any(
+                    getattr(k, "key", None) == "moe_aux_loss" for k in path
+                )
+            ]
+            if not leaves:
+                raise ValueError(
+                    "moe_aux_weight is set but the model sowed no moe_aux_loss — "
+                    "enable moe_experts on the tower configs"
+                )
+            aux = sum(jnp.sum(l) for l in leaves) / sum(l.size for l in leaves)
         loss = sharded_loss(zimg, ztxt, lp["t_prime"], lp["bias"])
-        return loss, lp
+        if moe_aux_weight is not None:
+            loss = loss + moe_aux_weight * aux
+        return loss, (lp, aux)
 
     def grads_and_metrics(params, batch):
         if accum_steps == 1:
-            (loss, lp), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (loss, (lp, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch
             )
-            return loss, lp, grads
+            return loss, lp, aux, grads
 
         d = mesh.shape[axis]
 
@@ -308,20 +343,22 @@ def make_train_step(
 
         def body(carry, mb):
             loss_sum, grad_sum = carry
-            (loss, lp), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (loss, (lp, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, mb
             )
             carry = (loss_sum + loss, jax.tree.map(jnp.add, grad_sum, grads))
-            return carry, lp
+            return carry, (lp, aux)
 
         zeros = jax.tree.map(jnp.zeros_like, params)
-        (loss_sum, grad_sum), lps = lax.scan(body, (jnp.zeros(()), zeros), micro)
+        (loss_sum, grad_sum), (lps, auxs) = lax.scan(
+            body, (jnp.zeros(()), zeros), micro
+        )
         lp = jax.tree.map(lambda x: x[-1], lps)
         grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
-        return loss_sum / accum_steps, lp, grads
+        return loss_sum / accum_steps, lp, jnp.mean(auxs), grads
 
     def step(state: TrainState, batch: dict):
-        loss, lp, grads = grads_and_metrics(state.params, batch)
+        loss, lp, aux, grads = grads_and_metrics(state.params, batch)
         prev_step = state.step  # apply_gradients increments; EMA warmup wants
         state = state.apply_gradients(grads=grads)  # the 0-based update index
         if zero1:
@@ -350,6 +387,8 @@ def make_train_step(
             "bias": lp["bias"],
             "grad_norm": optax.global_norm(grads),
         }
+        if moe_aux_weight is not None:
+            metrics["moe_aux"] = aux
         return state, metrics
 
     batch_sharding = {

@@ -51,6 +51,12 @@ class ViTConfig:
     # "nothing" = full remat; "save_hot" = save attention-core + MLP-hidden
     # activations across backward (recompute only projections/elementwise).
     remat_policy: Literal["nothing", "save_hot", "save_all_hot", "save_mlp"] = "nothing"
+    # Mixture-of-experts: >0 swaps each block's dense MLP for that many experts
+    # (expert weights shard over the "ep" mesh axis; see models/moe.py). Train
+    # with moe_aux_weight on make_train_step so routing stays balanced.
+    moe_experts: int = 0
+    moe_num_selected: int = 1  # 1 = Switch top-1, 2 = top-2 with renormalized gates
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def vit_b16(cls, **kw) -> "ViTConfig":
@@ -94,6 +100,10 @@ class TextConfig:
     # 2 collective hops; needs num_heads % axis_size == 0).
     sequence_parallel_impl: Literal["ring", "ulysses"] = "ring"
     causal: bool = False
+    # Mixture-of-experts (see ViTConfig): >0 enables MoE MLPs in the blocks.
+    moe_experts: int = 0
+    moe_num_selected: int = 1
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def base(cls, **kw) -> "TextConfig":

@@ -1,0 +1,240 @@
+"""Mixture-of-Experts MLP (expert parallelism over ``ep``) — parity oracles.
+
+Oracle pattern follows SURVEY.md §4: the einsum-dispatched MoE must equal the
+obvious per-token computation (select expert, run its MLP, weight by the gate)
+whenever capacity is ample; capacity drops must zero exactly the over-quota
+tokens; and the ep-sharded run must match the single-device one.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_sigmoid_loss_tpu.models.moe import MoeMlp
+from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+
+def _setup(num_selected=1, T=16, d=8, E=4, capacity_factor=8.0, seed=0):
+    m = MoeMlp(
+        width=d, mlp_ratio=2, num_experts=E, dtype=jnp.float32,
+        num_selected=num_selected, capacity_factor=capacity_factor,
+    )
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((2, T // 2, d)), jnp.float32)
+    params = nn.meta.unbox(m.init(jax.random.key(seed), x)["params"])
+    return m, params, x
+
+
+def _expert_mlp(params, i, xv):
+    h = nn.gelu(xv @ params["wi"][i], approximate=True)
+    return h @ params["wo"][i]
+
+
+def _dense_reference(params, x, num_selected):
+    """Per-token top-k expert compute — the semantics the einsum dispatch encodes."""
+    xt = x.reshape(-1, x.shape[-1])
+    probs = jax.nn.softmax(xt @ params["router"], axis=-1)
+    gates, idx = jax.lax.top_k(probs, num_selected)
+    if num_selected > 1:
+        gates = gates / gates.sum(-1, keepdims=True)
+    out = jnp.stack([
+        sum(
+            gates[t, j] * _expert_mlp(params, idx[t, j], xt[t])
+            for j in range(num_selected)
+        )
+        for t in range(xt.shape[0])
+    ])
+    return out.reshape(x.shape)
+
+
+@pytest.mark.parametrize("num_selected", [1, 2])
+def test_moe_matches_dense_per_token(num_selected):
+    m, params, x = _setup(num_selected)
+    y, _ = m.apply({"params": params}, x, mutable=["intermediates"])
+    want = _dense_reference(params, x, num_selected)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+def test_moe_capacity_drops_over_quota_tokens():
+    """With capacity_factor forcing C=1, only the first token routed to each expert
+    produces output; later ones drop to exactly zero (residual carries them)."""
+    T, d, E = 8, 8, 2
+    m = MoeMlp(
+        width=d, mlp_ratio=2, num_experts=E, dtype=jnp.float32,
+        capacity_factor=1.0 / (T / E),  # k*T/E * cf = 1 slot per expert
+    )
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((1, T, d)), jnp.float32)
+    params = nn.meta.unbox(m.init(jax.random.key(3), x)["params"])
+    y, _ = m.apply({"params": params}, x, mutable=["intermediates"])
+
+    xt = x.reshape(T, d)
+    probs = jax.nn.softmax(xt @ params["router"], axis=-1)
+    idx = np.asarray(jnp.argmax(probs, -1))
+    gate = np.asarray(jnp.max(probs, -1))
+    seen = set()
+    for t in range(T):
+        if idx[t] not in seen:  # first arrival: served
+            seen.add(idx[t])
+            want = gate[t] * _expert_mlp(params, idx[t], xt[t])
+            np.testing.assert_allclose(
+                np.asarray(y[0, t]), np.asarray(want), rtol=1e-5, atol=1e-6
+            )
+        else:  # over quota: dropped to zero
+            np.testing.assert_array_equal(np.asarray(y[0, t]), 0.0)
+
+
+def test_moe_aux_loss_balanced_routing_is_one():
+    """Uniform router probs + all-to-one-expert argmax ties give the Switch aux
+    loss its reference values: E·Σ f_e·P_e = 1 at perfect balance."""
+    d, E = 8, 4
+    m = MoeMlp(width=d, mlp_ratio=2, num_experts=E, dtype=jnp.float32)
+    x = jnp.ones((1, 8, d), jnp.float32)
+    params = nn.meta.unbox(m.init(jax.random.key(0), x)["params"])
+    # Zero router => uniform probs (P_e = 1/E); argmax ties resolve to expert 0
+    # (f = onehot(0)), so aux = E * (1 * 1/E) = 1.
+    params = dict(params, router=jnp.zeros_like(params["router"]))
+    _, state = m.apply({"params": params}, x, mutable=["intermediates"])
+    (aux,) = state["intermediates"]["moe_aux_loss"]
+    np.testing.assert_allclose(float(aux), 1.0, rtol=1e-6)
+
+
+def test_moe_sharded_matches_single_device():
+    """Experts sharded over a 4-device ep mesh: same outputs and gradients as the
+    unsharded run (the all-to-alls GSPMD inserts are semantics-free)."""
+    mesh = make_mesh(4, "ep")
+    m, params, x = _setup(T=32, E=4)
+
+    def loss(p, x):
+        y, _ = m.apply({"params": p}, x, mutable=["intermediates"])
+        return jnp.sum(y**2)
+
+    want_loss = loss(params, x)
+    want_grads = jax.grad(loss)(params, x)
+
+    shardings = {
+        "router": NamedSharding(mesh, P()),
+        "wi": NamedSharding(mesh, P("ep")),
+        "wo": NamedSharding(mesh, P("ep")),
+    }
+    params_s = jax.device_put(params, shardings)
+    x_s = jax.device_put(x, NamedSharding(mesh, P()))
+    got_loss = jax.jit(loss)(params_s, x_s)
+    got_grads = jax.jit(jax.grad(loss))(params_s, x_s)
+
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(got_grads[k]), np.asarray(want_grads[k]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_moe_validates_args():
+    x = jnp.zeros((1, 4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="num_selected"):
+        MoeMlp(width=8, mlp_ratio=2, num_experts=4, dtype=jnp.float32,
+               num_selected=3).init(jax.random.key(0), x)
+    with pytest.raises(ValueError, match="num_experts"):
+        MoeMlp(width=8, mlp_ratio=2, num_experts=1, dtype=jnp.float32).init(
+            jax.random.key(0), x
+        )
+
+
+def test_moe_train_step_end_to_end():
+    """Full SigLIP train step with MoE towers over a (dp=2, ep=4) mesh: loss and
+    aux finite, moe_aux reported, and the misconfiguration (aux weight without
+    MoE towers) raises clearly."""
+    import dataclasses
+
+    from jax.sharding import Mesh
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import (
+        LossConfig,
+        SigLIPConfig,
+        TrainConfig,
+    )
+
+    cfg = SigLIPConfig.tiny_test()
+    cfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, moe_experts=4),
+        text=dataclasses.replace(cfg.text, moe_experts=4, moe_num_selected=2),
+    )
+    model = SigLIP(cfg)
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "ep"))
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": jnp.asarray(rng.standard_normal((8, 16, 16, 3)), jnp.float32),
+        "tokens": jnp.asarray(rng.integers(0, 64, (8, 8)), jnp.int32),
+    }
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+    state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+    step, shardings = make_train_step(
+        model, mesh, LossConfig(variant="ring"), moe_aux_weight=0.01
+    )
+    batch = jax.device_put(batch, shardings)
+    _, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["moe_aux"]))
+
+    plain = SigLIP(SigLIPConfig.tiny_test())
+    state_p = create_train_state(jax.random.key(0), plain, tx, batch, mesh)
+    step_p, _ = make_train_step(
+        plain, mesh, LossConfig(variant="ring"), moe_aux_weight=0.01
+    )
+    with pytest.raises(ValueError, match="sowed no moe_aux_loss"):
+        step_p(state_p, batch)
+
+
+def test_moe_scanned_remat_encoder_aux_and_grads():
+    """The production encoder path (scan_layers=True + remat + save_hot) with MoE:
+    sown aux leaves ride nn.scan with a leading depth axis, gradients reach the
+    routers, and the remat'd values match the unremat'd ones."""
+    from distributed_sigmoid_loss_tpu.models.transformer import Encoder
+
+    def build(remat, remat_policy="save_hot"):
+        return Encoder(
+            width=16, depth=4, num_heads=2, mlp_ratio=2, dtype=jnp.float32,
+            remat=remat, scan_layers=True, remat_policy=remat_policy,
+            moe_experts=4,
+        )
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+    enc = build(remat=True)
+    params = nn.meta.unbox(enc.init(jax.random.key(0), x)["params"])
+
+    def loss(p, model):
+        y, variables = model.apply({"params": p}, x, mutable=["intermediates"])
+        leaves = jax.tree.leaves(variables["intermediates"])
+        assert leaves and leaves[0].shape[0] == 4  # (depth,) scan axis
+        return jnp.sum(y**2), leaves[0]
+
+    (val, aux), grads = jax.value_and_grad(
+        lambda p: loss(p, enc), has_aux=True
+    )(params)
+    assert np.isfinite(float(val))
+    assert np.isfinite(np.asarray(aux)).all()
+    router_grad = grads["blocks"]["block"]["moe"]["router"]
+    assert float(jnp.abs(router_grad).max()) > 0.0
+
+    # Remat must not change the math.
+    (val_nr, _), grads_nr = jax.value_and_grad(
+        lambda p: loss(p, build(remat=False)), has_aux=True
+    )(params)
+    np.testing.assert_allclose(float(val), float(val_nr), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads["blocks"]["block"]["moe"]["router"]),
+        np.asarray(grads_nr["blocks"]["block"]["moe"]["router"]),
+        rtol=1e-4, atol=1e-6,
+    )
