@@ -9,6 +9,10 @@ from distributed_sigmoid_loss_tpu.data.synthetic import (  # noqa: F401
     shard_batch,
 )
 from distributed_sigmoid_loss_tpu.data.tokenizer import ByteTokenizer  # noqa: F401
+from distributed_sigmoid_loss_tpu.data.native_loader import (  # noqa: F401
+    NativeSyntheticImageText,
+    native_available,
+)
 from distributed_sigmoid_loss_tpu.data.augment import (  # noqa: F401
     augment_batch,
     color_jitter,

@@ -1,0 +1,217 @@
+"""ctypes binding for the native (C++) input-pipeline engine.
+
+``native/dataloader.cc`` is the framework's host-side native runtime component:
+a worker pool generates batches into a bounded ring of reusable buffers off the
+GIL, and Python drains them in strict batch-index order with one memcpy — the
+role torch's native DataLoader workers / tf.data's C++ runtime play for the
+reference ecosystem. Batches are a pure function of (seed, batch_index), so the
+stream is deterministic regardless of thread count (tested in
+tests/test_native_loader.py).
+
+The binding uses ctypes (no pybind11 in this environment); the shared library is
+built on first use with g++ (``native/Makefile`` has the same recipe). Callers
+should treat :class:`NativeSyntheticImageText` as a faster drop-in for
+``data.synthetic.SyntheticImageText`` — same dict-of-arrays batches, compose
+with ``data.loader.prefetch`` for the host→device overlap. Use
+:func:`native_available` to fall back to the numpy pipeline where no C++
+toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+__all__ = ["native_available", "NativeSyntheticImageText", "load_library"]
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SRC = os.path.join(_NATIVE_DIR, "dataloader.cc")
+_LIB = os.path.join(_NATIVE_DIR, "libdsl_data.so")
+_build_lock = threading.Lock()
+_lib = None
+
+
+# One flag list for both build paths (the Makefile defaults to the same set and
+# both honor a CXXFLAGS override).
+_DEFAULT_CXXFLAGS = "-O3 -std=c++17 -fPIC -Wall -Wextra -pthread"
+
+
+def _build() -> str:
+    """Compile the shared library when missing or older than its source.
+
+    A prebuilt ``.so`` without the source (deployment artifact) is used as-is;
+    a stale ``.so`` on a machine without a compiler is used with a warning
+    rather than failing a working setup.
+    """
+    have_lib = os.path.exists(_LIB)
+    if not os.path.exists(_SRC):
+        if have_lib:
+            return _LIB
+        raise RuntimeError(
+            f"native dataloader: neither {_LIB} nor its source {_SRC} exists"
+        )
+    if have_lib and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return _LIB
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        *os.environ.get("CXXFLAGS", _DEFAULT_CXXFLAGS).split(),
+        "-shared", "-o", _LIB, _SRC,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        failure = proc.returncode != 0 and (
+            f"exit {proc.returncode}:\n{proc.stderr}"
+        )
+    except OSError as e:  # compiler missing entirely
+        failure = str(e)
+    if failure:
+        if have_lib:
+            import warnings
+
+            warnings.warn(
+                f"native dataloader: rebuild for newer {_SRC} failed "
+                f"({failure}); using the existing (stale) {_LIB}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return _LIB
+        raise RuntimeError(
+            f"native dataloader build failed ({' '.join(cmd)}): {failure}"
+        )
+    return _LIB
+
+
+def load_library():
+    """Build if needed and load the engine; raises where no toolchain exists."""
+    global _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(_build())
+        lib.dsl_pipeline_create.restype = ctypes.c_void_p
+        lib.dsl_pipeline_create.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.dsl_pipeline_next.restype = ctypes.c_int64
+        lib.dsl_pipeline_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.dsl_pipeline_stop.restype = None
+        lib.dsl_pipeline_stop.argtypes = [ctypes.c_void_p]
+        lib.dsl_pipeline_destroy.restype = None
+        lib.dsl_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    """True when the engine can be used — mirrors :func:`_build`'s requirements:
+    a prebuilt .so suffices (even stale: _build warns and keeps it), otherwise
+    the source plus a working compiler must be present."""
+    if os.path.exists(_LIB):
+        return True
+    if not os.path.exists(_SRC):
+        return False
+    try:
+        subprocess.run(
+            [os.environ.get("CXX", "g++"), "--version"],
+            capture_output=True, check=True,
+        )
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+class NativeSyntheticImageText:
+    """Drop-in for ``SyntheticImageText`` backed by the C++ engine.
+
+    Yields ``{"images": (B,H,W,3) f32, "tokens": (B,L) i32}`` numpy batches;
+    generation for batch ``n+1..n+queue_depth`` proceeds on C++ threads while
+    the caller consumes batch ``n``.
+    """
+
+    def __init__(
+        self,
+        cfg: SigLIPConfig,
+        global_batch: int,
+        image_seed: int = 42,
+        text_seed: int = 40,
+        num_threads: int = 4,
+        queue_depth: int = 4,
+    ):
+        self.cfg = cfg
+        self.global_batch = global_batch
+        self._lib = load_library()
+        self._handle = self._lib.dsl_pipeline_create(
+            global_batch, cfg.vision.image_size, cfg.text.context_length,
+            cfg.text.vocab_size, image_seed, text_seed, num_threads, queue_depth,
+        )
+        if not self._handle:
+            raise ValueError(
+                "dsl_pipeline_create rejected the config (all sizes/threads/"
+                "depth must be positive)"
+            )
+        v = cfg.vision
+        self._image_shape = (global_batch, v.image_size, v.image_size, 3)
+        self._token_shape = (global_batch, cfg.text.context_length)
+        self._closed = False
+        # Serializes next() calls against close(): close() first wakes any
+        # consumer blocked inside the native call (dsl_pipeline_stop, taken
+        # WITHOUT this lock), then frees the engine under the lock — so destroy
+        # can never race a thread (e.g. the loader.prefetch worker) mid-call.
+        self._iter_lock = threading.Lock()
+        self._close_lock = threading.Lock()  # serializes concurrent close()rs
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            images = np.empty(self._image_shape, np.float32)
+            tokens = np.empty(self._token_shape, np.int32)
+            with self._iter_lock:
+                if self._closed:
+                    return
+                n = self._lib.dsl_pipeline_next(
+                    self._handle,
+                    images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                )
+            if n < 0:  # stopped under our feet
+                return
+            yield {"images": images, "tokens": tokens}
+
+    def close(self):
+        with self._close_lock:
+            if self._closed or not self._handle:
+                return
+            # Wake any blocked consumer first — it holds _iter_lock while inside
+            # the native call (ctypes released the GIL), so a locked stop would
+            # deadlock.
+            self._lib.dsl_pipeline_stop(self._handle)
+            with self._iter_lock:
+                self._closed = True
+                self._lib.dsl_pipeline_destroy(self._handle)
+                self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

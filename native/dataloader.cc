@@ -1,0 +1,224 @@
+// Native (C++17) input-pipeline engine: multithreaded batch generation with a
+// bounded ring of preallocated slots.
+//
+// Role in the framework: the host-side analogue of the reference's native layer.
+// The reference ships no native code of its own — its native dependency is the
+// Gloo/NCCL comm backend consumed through torch.distributed (SURVEY.md §2); on
+// TPU that layer is XLA's collective runtime. What a real TPU training job still
+// needs from the host is a data engine that keeps the input queue full while
+// Python drives the train loop — the role torch's native DataLoader workers /
+// tf.data's C++ runtime play. This file is that engine: worker threads
+// generate/transform batches into a ring of reusable buffers; the consumer
+// (Python, via ctypes — see data/native_loader.py) drains batches in order with
+// one memcpy into numpy and no GIL contention during generation.
+//
+// Batch semantics mirror data/synthetic.py: standard-normal float32 images
+// (NHWC) and uniform int32 token ids — deterministic given (seed, batch_index)
+// and therefore INDEPENDENT of thread count or scheduling: every batch's
+// content is a pure function of its index (counter-based RNG seeding), and the
+// ring hands batches to the consumer strictly in index order.
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// splitmix64: seed expander (Steele et al.) — mixes (seed, batch, stream) into
+// uncorrelated xoshiro starting states.
+static inline uint64_t splitmix64(uint64_t& x) {
+  uint64_t z = (x += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// xoshiro256** — fast, high-quality 64-bit generator.
+struct Xoshiro {
+  uint64_t s[4];
+  explicit Xoshiro(uint64_t seed) {
+    for (int i = 0; i < 4; ++i) s[i] = splitmix64(seed);
+  }
+  static inline uint64_t rotl(uint64_t x, int k) {
+    return (x << k) | (x >> (64 - k));
+  }
+  inline uint64_t next() {
+    const uint64_t result = rotl(s[1] * 5, 7) * 9;
+    const uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+  // Uniform in [0, 1): top 53 bits.
+  inline double uniform() { return (next() >> 11) * 0x1.0p-53; }
+};
+
+struct Slot {
+  std::vector<float> images;
+  std::vector<int32_t> tokens;
+  // Batch index whose data this slot currently holds (-1 = none), and the last
+  // batch index the consumer finished with (slot reusable for last + depth).
+  int64_t ready = -1;
+  int64_t last_consumed;  // initialized to slot_id - depth
+};
+
+struct Pipeline {
+  // Static config.
+  int64_t batch, image_size, context, vocab;
+  uint64_t image_seed, text_seed;
+  int depth;
+  size_t image_elems, token_elems;
+
+  std::vector<Slot> slots;
+  std::mutex mu;
+  std::condition_variable slot_freed, slot_ready, idle;
+  std::atomic<int64_t> next_claim{0};
+  int64_t next_consume = 0;
+  int consumers_inside = 0;
+  bool stopping = false;
+  std::vector<std::thread> workers;
+
+  void generate(int64_t n, Slot& slot) {
+    // Counter-based seeding: batch content depends only on (seed, n).
+    uint64_t is = image_seed ^ (0xA0761D64ULL + (uint64_t)n * 0x9E3779B97F4A7C15ULL);
+    Xoshiro irng(is);
+    float* img = slot.images.data();
+    const size_t ne = image_elems;
+    // Box-Muller in pairs: standard-normal images, like numpy standard_normal.
+    for (size_t i = 0; i + 1 < ne; i += 2) {
+      double u1 = irng.uniform(), u2 = irng.uniform();
+      double r = std::sqrt(-2.0 * std::log(1.0 - u1));  // 1-u1 in (0,1]: log finite
+      double a = 6.283185307179586 * u2;
+      img[i] = (float)(r * std::cos(a));
+      img[i + 1] = (float)(r * std::sin(a));
+    }
+    if (ne & 1) {
+      double u1 = irng.uniform(), u2 = irng.uniform();
+      img[ne - 1] =
+          (float)(std::sqrt(-2.0 * std::log(1.0 - u1)) *
+                  std::cos(6.283185307179586 * u2));
+    }
+    uint64_t ts = text_seed ^ (0x7F4A7C15ULL + (uint64_t)n * 0xBF58476D1CE4E5B9ULL);
+    Xoshiro trng(ts);
+    int32_t* tok = slot.tokens.data();
+    for (size_t i = 0; i < token_elems; ++i) {
+      // Rejection-free modulo is fine here: vocab << 2^64, bias is ~2^-50.
+      tok[i] = (int32_t)(trng.next() % (uint64_t)vocab);
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    slot.ready = n;
+    slot_ready.notify_all();
+  }
+
+  void worker_loop() {
+    for (;;) {
+      const int64_t n = next_claim.fetch_add(1);
+      Slot& slot = slots[n % depth];
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        slot_freed.wait(lk, [&] {
+          return stopping || slot.last_consumed == n - depth;
+        });
+        if (stopping) return;
+      }
+      generate(n, slot);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Pipeline* dsl_pipeline_create(int64_t batch, int64_t image_size, int64_t context,
+                              int64_t vocab, uint64_t image_seed,
+                              uint64_t text_seed, int threads, int depth) {
+  if (batch <= 0 || image_size <= 0 || context <= 0 || vocab <= 0 ||
+      threads <= 0 || depth <= 0)
+    return nullptr;
+  auto* p = new Pipeline();
+  p->batch = batch;
+  p->image_size = image_size;
+  p->context = context;
+  p->vocab = vocab;
+  p->image_seed = image_seed;
+  p->text_seed = text_seed;
+  p->depth = depth;
+  p->image_elems = (size_t)batch * image_size * image_size * 3;
+  p->token_elems = (size_t)batch * context;
+  p->slots.resize(depth);
+  for (int i = 0; i < depth; ++i) {
+    p->slots[i].images.resize(p->image_elems);
+    p->slots[i].tokens.resize(p->token_elems);
+    p->slots[i].last_consumed = (int64_t)i - depth;
+  }
+  for (int i = 0; i < threads; ++i)
+    p->workers.emplace_back([p] { p->worker_loop(); });
+  return p;
+}
+
+// Copies the next batch (in strict index order) into caller buffers sized
+// batch*image_size*image_size*3 floats / batch*context int32s. Returns the
+// batch index, or -1 after dsl_pipeline_stop/destroy began.
+int64_t dsl_pipeline_next(Pipeline* p, float* images, int32_t* tokens) {
+  int64_t n;
+  Slot* slot;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->stopping) return -1;
+    ++p->consumers_inside;
+    n = p->next_consume;
+    slot = &p->slots[n % p->depth];
+    p->slot_ready.wait(lk, [&] { return p->stopping || slot->ready == n; });
+    if (p->stopping) {
+      --p->consumers_inside;
+      p->idle.notify_all();
+      return -1;
+    }
+  }
+  std::memcpy(images, slot->images.data(), p->image_elems * sizeof(float));
+  std::memcpy(tokens, slot->tokens.data(), p->token_elems * sizeof(int32_t));
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    slot->ready = -1;
+    slot->last_consumed = n;
+    p->next_consume = n + 1;
+    p->slot_freed.notify_all();
+    --p->consumers_inside;
+    p->idle.notify_all();
+  }
+  return n;
+}
+
+// Wakes every blocked consumer/worker (they return -1 / exit) without freeing
+// anything — lets the caller unblock its consumer threads before destroy.
+void dsl_pipeline_stop(Pipeline* p) {
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->stopping = true;
+  p->slot_freed.notify_all();
+  p->slot_ready.notify_all();
+}
+
+void dsl_pipeline_destroy(Pipeline* p) {
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->stopping = true;
+    p->slot_freed.notify_all();
+    p->slot_ready.notify_all();
+    // Don't free under a live consumer: wait for in-flight next() calls.
+    p->idle.wait(lk, [&] { return p->consumers_inside == 0; });
+  }
+  for (auto& t : p->workers) t.join();
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,122 @@
+"""Native (C++) input-pipeline engine — build, determinism, ordering, integration.
+
+The engine's contract (native/dataloader.cc): batches are a pure function of
+(seed, batch_index) — thread count and scheduling must never change the stream —
+and the consumer sees batches in strict index order. These tests exercise the
+full ctypes surface; they skip only where no C++ toolchain exists.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.data.native_loader import (
+    NativeSyntheticImageText,
+    native_available,
+)
+from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain or prebuilt libdsl_data.so"
+)
+
+
+def _take(ds, n):
+    it = iter(ds)
+    return [next(it) for _ in range(n)]
+
+
+def test_shapes_dtypes_and_distribution():
+    cfg = SigLIPConfig.tiny_test()
+    with NativeSyntheticImageText(cfg, 32, num_threads=2) as ds:
+        (batch,) = _take(ds, 1)
+    v, t = cfg.vision, cfg.text
+    assert batch["images"].shape == (32, v.image_size, v.image_size, 3)
+    assert batch["images"].dtype == np.float32
+    assert batch["tokens"].shape == (32, t.context_length)
+    assert batch["tokens"].dtype == np.int32
+    assert 0 <= batch["tokens"].min() and batch["tokens"].max() < t.vocab_size
+    # Standard-normal images (enough elements for tight bounds).
+    assert abs(float(batch["images"].mean())) < 0.05
+    assert abs(float(batch["images"].std()) - 1.0) < 0.05
+
+
+def test_deterministic_across_thread_counts_and_instances():
+    cfg = SigLIPConfig.tiny_test()
+    with NativeSyntheticImageText(cfg, 16, num_threads=1) as a, \
+         NativeSyntheticImageText(cfg, 16, num_threads=7, queue_depth=3) as b:
+        for ba, bb in zip(_take(a, 5), _take(b, 5)):
+            np.testing.assert_array_equal(ba["images"], bb["images"])
+            np.testing.assert_array_equal(ba["tokens"], bb["tokens"])
+
+
+def test_stream_advances_and_seeds_differ():
+    cfg = SigLIPConfig.tiny_test()
+    with NativeSyntheticImageText(cfg, 16) as ds:
+        b0, b1 = _take(ds, 2)
+    assert not np.array_equal(b0["images"], b1["images"])
+    assert not np.array_equal(b0["tokens"], b1["tokens"])
+    with NativeSyntheticImageText(cfg, 16, image_seed=7, text_seed=8) as other:
+        (o0,) = _take(other, 1)
+    assert not np.array_equal(b0["images"], o0["images"])
+
+
+def test_rejects_bad_config():
+    cfg = SigLIPConfig.tiny_test()
+    with pytest.raises(ValueError, match="positive"):
+        NativeSyntheticImageText(cfg, 0)
+
+
+def test_feeds_training_pipeline():
+    """Native batches flow through the standard device-placement path into a
+    jitted step (the drop-in contract with data.synthetic)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_sigmoid_loss_tpu.data.loader import prefetch
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+    cfg = SigLIPConfig.tiny_test()
+    mesh = make_mesh(4)
+
+    @jax.jit
+    def summarize(batch):
+        return jnp.mean(batch["images"]), jnp.max(batch["tokens"])
+
+    with NativeSyntheticImageText(cfg, 16, num_threads=2) as ds:
+        got = []
+        for batch in prefetch(iter(ds), mesh, size=2):
+            got.append(summarize(batch))
+            if len(got) == 3:
+                break
+    for mean, mx in got:
+        assert np.isfinite(float(mean))
+        assert 0 <= int(mx) < cfg.text.vocab_size
+
+
+def test_close_while_consumer_blocked():
+    """Closing from another thread while a consumer is blocked inside the native
+    next() must cleanly end the stream — the regression that used to
+    use-after-free at prefetch teardown."""
+    import threading
+    import time
+
+    cfg = SigLIPConfig.tiny_test()
+    ds = NativeSyntheticImageText(cfg, 8, num_threads=1, queue_depth=2)
+    it = iter(ds)
+    consumed = []
+    done = threading.Event()
+
+    def consume():
+        for batch in it:
+            consumed.append(batch["tokens"][0, 0])
+            time.sleep(0.01)
+        done.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.1)  # let it block in/around the native call
+    ds.close()
+    assert done.wait(timeout=5.0), "consumer did not unblock after close()"
+    t.join(timeout=5.0)
+    assert consumed  # it was actually streaming before the close
+    ds.close()  # idempotent
