@@ -49,12 +49,26 @@ def _model_config(args):
             f"--tiny conflicts with --model {args.model}; pass one or the other"
         )
     name = "tiny" if getattr(args, "tiny", False) else args.model
-    return {
+    cfg = {
         "tiny": SigLIPConfig.tiny_test,
         "l14": SigLIPConfig.l14,
         "so400m": SigLIPConfig.so400m,
         "b16": SigLIPConfig.b16,
     }[name]()
+    moe = getattr(args, "moe_experts", 0)
+    if moe:
+        # Shared by train AND eval: a checkpoint trained with --moe-experts can
+        # only be restored into an identically-shaped (MoE) model.
+        if moe < 2:
+            raise SystemExit(f"--moe-experts must be >= 2, got {moe}")
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(cfg.vision, moe_experts=moe),
+            text=dataclasses.replace(cfg.text, moe_experts=moe),
+        )
+    return cfg
 
 
 def cmd_train(args) -> int:
@@ -113,7 +127,44 @@ def cmd_train(args) -> int:
     from distributed_sigmoid_loss_tpu.utils.logging import MetricsLogger
 
     cfg = _model_config(args)
-    mesh = make_mesh()
+    if args.ep < 1:
+        print(f"--ep must be >= 1, got {args.ep}", file=sys.stderr)
+        return 2
+    if args.moe_aux_weight is not None and not args.moe_experts:
+        print(
+            "--moe-aux-weight without --moe-experts would be a silent no-op "
+            "(a dense model has no routers to balance)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.ep > 1:
+        from distributed_sigmoid_loss_tpu.models.moe import EP_AXIS
+        from distributed_sigmoid_loss_tpu.parallel.mesh import (
+            data_axis,
+            make_2d_mesh,
+        )
+
+        n_dev = len(jax.devices())
+        if not args.moe_experts:
+            print(
+                "--ep > 1 without --moe-experts would only shrink data "
+                "parallelism (a dense model has no ep-sharded params)",
+                file=sys.stderr,
+            )
+            return 2
+        if n_dev % args.ep:
+            print(f"--ep {args.ep} must divide device count {n_dev}", file=sys.stderr)
+            return 2
+        if args.moe_experts % args.ep:
+            print(
+                f"--ep {args.ep} must divide --moe-experts {args.moe_experts} "
+                f"(expert kernels are stacked (E, ...) and sharded over ep)",
+                file=sys.stderr,
+            )
+            return 2
+        mesh = make_2d_mesh(n_dev // args.ep, args.ep, axis_names=(data_axis, EP_AXIS))
+    else:
+        mesh = make_mesh()
     pidx, pcnt = jax.process_index(), jax.process_count()
     print(
         f"mesh: {dict(mesh.shape)} devices={len(jax.devices())}"
@@ -127,7 +178,29 @@ def cmd_train(args) -> int:
             learning_rate=args.lr, warmup_steps=5, total_steps=max(args.steps, 10)
         )
     )
-    data = iter(SyntheticImageText(cfg, args.batch))
+    source = None
+    if args.native_data:
+        from distributed_sigmoid_loss_tpu.data import (
+            NativeSyntheticImageText,
+            native_available,
+        )
+
+        reason = "no C++ toolchain or prebuilt library"
+        if native_available():
+            try:
+                source = NativeSyntheticImageText(cfg, args.batch)
+            except (RuntimeError, OSError) as e:
+                # available() can't foresee every build failure (old compiler,
+                # read-only install dir); the flag promises a fallback either way.
+                reason = f"engine unusable: {e}"
+        if source is None:
+            print(
+                f"--native-data: {reason}; falling back to the numpy pipeline",
+                file=sys.stderr,
+            )
+    if source is None:
+        source = SyntheticImageText(cfg, args.batch)
+    data = iter(source)
     first = next(data)
 
     state = create_train_state(
@@ -141,6 +214,11 @@ def cmd_train(args) -> int:
         accum_steps=args.accum,
         zero1=args.zero1,
         ema_decay=args.ema_decay,
+        moe_aux_weight=(
+            (0.01 if args.moe_aux_weight is None else args.moe_aux_weight)
+            if args.moe_experts
+            else None
+        ),
     )
 
     logger = MetricsLogger(every=args.log_every)
@@ -370,6 +448,19 @@ def main(argv=None) -> int:
     tr.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"], default="b16")
     tr.add_argument("--tiny", action="store_true", help="alias for --model tiny")
     tr.add_argument("--accum", type=int, default=1, help="grad-accumulation microsteps")
+    tr.add_argument("--moe-experts", type=int, default=0,
+                    help="swap tower MLPs for this many experts per block "
+                         "(mixture-of-experts; shards over an ep mesh axis)")
+    tr.add_argument("--moe-aux-weight", type=float, default=None,
+                    help="router load-balancing loss weight (requires "
+                         "--moe-experts; default 0.01 when MoE is on)")
+    tr.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel mesh factor (with --moe-experts): mesh "
+                         "becomes (dp = devices/ep, ep); 1 = replicated experts")
+    tr.add_argument("--native-data", action="store_true",
+                    help="use the C++ input-pipeline engine (native/dataloader.cc) "
+                         "instead of the numpy pipeline; falls back with a notice "
+                         "when no toolchain is available")
     tr.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over dp (ZeRO-1) — fits "
                          "so400m-class towers in v5e HBM")
@@ -398,6 +489,8 @@ def main(argv=None) -> int:
     ev.add_argument("--classes", type=int, default=10)
     ev.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"], default="b16")
     ev.add_argument("--tiny", action="store_true", help="alias for --model tiny")
+    ev.add_argument("--moe-experts", type=int, default=0,
+                    help="match a checkpoint trained with --moe-experts")
     ev.add_argument("--cpu-devices", type=int, default=0)
     ev.add_argument("--ckpt-dir", default="", help="restore params from this checkpoint")
     ev.add_argument("--ema", action="store_true",

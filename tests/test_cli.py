@@ -191,9 +191,63 @@ def test_eval_wrong_model_surfaces_real_error(tmp_path):
          "--ema-decay", "0.9", "--ckpt-dir", ck, "--ckpt-every", "2"]
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
+    # Generous timeout: the b16 CPU compile alone is ~5 min when the machine is
+    # contended (this subprocess timing out is the suite's one flake mode).
     proc = _run(
         ["eval", "--cpu-devices", "8", "--model", "b16", "--batch", "16",
-         "--ckpt-dir", ck, "--ema"], timeout=420,
+         "--ckpt-dir", ck, "--ema"], timeout=900,
     )
     assert proc.returncode not in (0, 2), proc.stderr[-500:]
     assert "no EMA weights" not in proc.stderr
+
+
+def test_train_moe_native_data_then_eval(tmp_path):
+    """MoE towers over an (dp, ep) mesh fed by the native C++ pipeline, then the
+    checkpoint restored by eval with the matching --moe-experts — the full
+    beyond-reference surface in two CLI invocations."""
+    ck = str(tmp_path / "ck")
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "3", "--batch", "16",
+         "--moe-experts", "4", "--ep", "4", "--native-data",
+         "--ckpt-dir", ck, "--ckpt-every", "2"]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert [l["step"] for l in lines] == [1, 2, 3]
+    assert all("moe_aux" in l for l in lines)
+
+    proc = _run(
+        ["eval", "--cpu-devices", "8", "--tiny", "--batch", "16", "--classes", "4",
+         "--ckpt-dir", ck, "--moe-experts", "4"]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "restored step" in proc.stderr
+    assert "zeroshot_top@1" in proc.stdout
+
+
+def test_train_rejects_bad_moe_flags():
+    for extra, rc, msg in [
+        (["--moe-experts", "4", "--ep", "3"], 2, "must divide device count"),
+        (["--ep", "2"], 2, "without --moe-experts"),
+        (["--moe-experts", "6", "--ep", "4"], 2, "must divide --moe-experts"),
+        (["--moe-experts", "1"], 1, "must be >= 2"),
+    ]:
+        proc = _run(
+            ["train", "--cpu-devices", "8", "--tiny", "--steps", "1",
+             "--batch", "16", *extra]
+        )
+        assert proc.returncode == rc, (extra, proc.returncode, proc.stderr[-500:])
+        assert msg in proc.stderr, (extra, proc.stderr[-500:])
+
+
+def test_train_rejects_orphan_moe_aux_weight_and_bad_ep_zero():
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "1", "--batch", "16",
+         "--moe-aux-weight", "0.1"]
+    )
+    assert proc.returncode == 2 and "silent no-op" in proc.stderr
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "1", "--batch", "16",
+         "--moe-experts", "4", "--ep", "0"]
+    )
+    assert proc.returncode == 2 and "--ep must be >= 1" in proc.stderr
