@@ -13,6 +13,11 @@ from distributed_sigmoid_loss_tpu.data.native_loader import (  # noqa: F401
     NativeSyntheticImageText,
     native_available,
 )
+from distributed_sigmoid_loss_tpu.data.files import (  # noqa: F401
+    ImageTextFolder,
+    ImageTextShards,
+    decode_and_resize,
+)
 from distributed_sigmoid_loss_tpu.data.augment import (  # noqa: F401
     augment_batch,
     color_jitter,

@@ -1,0 +1,239 @@
+"""Real image-text datasets: folders of pairs and webdataset-style tar shards.
+
+The reference trains on nothing (its data layer is seeded tensors,
+/root/reference/test_distributed_sigmoid_loss.py:57-68); contrastive pretraining
+in its ecosystem (open_clip) reads webdataset tar shards of (image, caption)
+pairs. This module provides the same two on-disk layouts without external
+dependencies:
+
+- :class:`ImageTextFolder` — a directory of ``name.{jpg,png,...}`` +
+  ``name.txt`` caption pairs (the small-dataset / debugging layout).
+- :class:`ImageTextShards` — webdataset-style ``.tar`` shards whose members are
+  those same pairs grouped by basename (the at-scale layout; tar is read
+  sequentially, one shard at a time — the access pattern object stores like).
+
+Both yield training-ready batches: images decoded (PIL), resized to the tower's
+``image_size`` with the standard shorter-side-resize + center-crop, scaled to
+[-1, 1] (SigLIP's inference normalization); captions tokenized by any
+``(texts, length) -> ids`` callable (e.g. ``data.ByteTokenizer``). Multi-host
+jobs compose the usual way: pass ``shard_index/num_shards`` per process so each
+host reads a disjoint slice, then feed ``data.global_batch_from_local``.
+
+TPU note: decode/resize is host CPU work — wrap the iterator in
+``data.prefetch`` so it overlaps device compute, and batches are full global
+batches with static shapes (drop-last), so one compiled step serves the stream.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import Callable, Iterator, Sequence
+
+import numpy as np
+
+__all__ = ["ImageTextFolder", "ImageTextShards", "decode_and_resize"]
+
+_IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+def decode_and_resize(data: bytes, image_size: int) -> np.ndarray:
+    """bytes → (image_size, image_size, 3) float32 in [-1, 1].
+
+    Shorter-side resize then center crop (the open_clip/SigLIP eval transform),
+    bilinear. Grayscale/RGBA inputs are converted to RGB.
+    """
+    from io import BytesIO
+
+    from PIL import Image
+
+    with Image.open(BytesIO(data)) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        scale = image_size / min(w, h)
+        nw, nh = max(image_size, round(w * scale)), max(image_size, round(h * scale))
+        im = im.resize((nw, nh), Image.BILINEAR)
+        left, top = (nw - image_size) // 2, (nh - image_size) // 2
+        im = im.crop((left, top, left + image_size, top + image_size))
+        arr = np.asarray(im, np.float32)
+    return arr / 127.5 - 1.0
+
+
+def _pair_key(name: str) -> tuple[str, str] | None:
+    base, ext = os.path.splitext(name)
+    ext = ext.lower()
+    if ext in _IMAGE_EXTS:
+        return base, "image"
+    if ext == ".txt":
+        return base, "text"
+    return None
+
+
+class _PairBatcher:
+    """Accumulate (image_bytes, caption) pairs into static-shape batches."""
+
+    def __init__(self, cfg, batch_size: int, tokenize: Callable):
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.tokenize = tokenize
+        self._images: list[np.ndarray] = []
+        self._texts: list[str] = []
+
+    def add(self, image_bytes: bytes, caption: str) -> dict | None:
+        self._images.append(
+            decode_and_resize(image_bytes, self.cfg.vision.image_size)
+        )
+        self._texts.append(caption)
+        if len(self._images) < self.batch_size:
+            return None
+        tokens = np.asarray(
+            self.tokenize(self._texts, self.cfg.text.context_length), np.int32
+        )
+        if tokens.min() < 0 or tokens.max() >= self.cfg.text.vocab_size:
+            # Out-of-range ids reach nn.Embed as NaNs under jit (jnp.take fill
+            # mode) — fail loudly here instead. E.g. ByteTokenizer needs
+            # vocab_size >= 259; fold ids (tokens % vocab_size) to use a
+            # smaller test vocab deliberately.
+            raise ValueError(
+                f"tokenizer produced ids in [{tokens.min()}, {tokens.max()}] "
+                f"outside vocab_size {self.cfg.text.vocab_size}"
+            )
+        batch = {"images": np.stack(self._images), "tokens": tokens}
+        self._images, self._texts = [], []
+        return batch
+
+
+class ImageTextFolder:
+    """Directory of ``name.jpg`` + ``name.txt`` pairs → global batches.
+
+    Deterministic order (sorted basenames, shuffled per epoch by ``seed`` when
+    set); incomplete pairs are skipped; the final partial batch is dropped
+    (static shapes). Iterating cycles epochs forever — bound the train loop by
+    steps, as the CLI does.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        cfg,
+        batch_size: int,
+        tokenize: Callable,
+        seed: int | None = 0,
+    ):
+        self.root = root
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.tokenize = tokenize
+        self.seed = seed
+        pairs: dict[str, dict] = {}
+        for name in sorted(os.listdir(root)):
+            key = _pair_key(name)
+            if key is None:
+                continue
+            base, kind = key
+            pairs.setdefault(base, {})[kind] = os.path.join(root, name)
+        self.items: list[dict] = [
+            p for _, p in sorted(pairs.items()) if "image" in p and "text" in p
+        ]
+        if len(self.items) < batch_size:
+            raise ValueError(
+                f"{root} holds {len(self.items)} complete pairs; "
+                f"need at least one batch of {batch_size}"
+            )
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    def __iter__(self) -> Iterator[dict]:
+        rng = np.random.default_rng(self.seed) if self.seed is not None else None
+        while True:
+            order = np.arange(len(self.items))
+            if rng is not None:
+                rng.shuffle(order)
+            batcher = _PairBatcher(self.cfg, self.batch_size, self.tokenize)
+            for i in order:
+                item = self.items[i]
+                with open(item["image"], "rb") as f:
+                    image_bytes = f.read()
+                with open(item["text"], "r", encoding="utf-8") as f:
+                    caption = f.read().strip()
+                batch = batcher.add(image_bytes, caption)
+                if batch is not None:
+                    yield batch
+
+
+class ImageTextShards:
+    """Webdataset-style tar shards of ``name.jpg`` + ``name.txt`` members.
+
+    ``shards`` is a list of tar paths (or a glob result); ``shard_index /
+    num_shards`` stripes the shard list across hosts (process i reads shards
+    i, i+N, i+2N, ... — the standard multi-host split, zero coordination).
+    Members are paired by basename within a shard; pairs stream in tar order
+    (shard-shuffled per epoch by ``seed``), so memory stays O(batch).
+    """
+
+    def __init__(
+        self,
+        shards: Sequence[str],
+        cfg,
+        batch_size: int,
+        tokenize: Callable,
+        seed: int | None = 0,
+        shard_index: int = 0,
+        num_shards: int = 1,
+    ):
+        if not shards:
+            raise ValueError("no shards given")
+        if not (0 <= shard_index < num_shards):
+            raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
+        self.shards = sorted(shards)[shard_index::num_shards]
+        if not self.shards:
+            raise ValueError(
+                f"host {shard_index}/{num_shards} received no shards "
+                f"({len(shards)} total) — use at least num_shards tar files"
+            )
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.tokenize = tokenize
+        self.seed = seed
+
+    def __iter__(self) -> Iterator[dict]:
+        rng = np.random.default_rng(self.seed) if self.seed is not None else None
+        while True:
+            yielded = False
+            order = np.arange(len(self.shards))
+            if rng is not None:
+                rng.shuffle(order)
+            batcher = _PairBatcher(self.cfg, self.batch_size, self.tokenize)
+            for si in order:
+                with tarfile.open(self.shards[si], "r") as tf:
+                    pending: dict[str, dict] = {}
+                    for member in tf:
+                        if not member.isfile():
+                            continue
+                        key = _pair_key(os.path.basename(member.name))
+                        if key is None:
+                            continue
+                        base, kind = key
+                        buf = tf.extractfile(member)
+                        if buf is None:
+                            continue
+                        entry = pending.setdefault(base, {})
+                        entry[kind] = buf.read()
+                        if "image" in entry and "text" in entry:
+                            del pending[base]
+                            batch = batcher.add(
+                                entry["image"],
+                                entry["text"].decode("utf-8").strip(),
+                            )
+                            if batch is not None:
+                                yielded = True
+                                yield batch
+            if not yielded:
+                # Mirror ImageTextFolder's too-few-pairs ValueError (which can
+                # check up front); here pair counts are only known after a full
+                # pass, and spinning on the tars forever would hang next().
+                raise ValueError(
+                    f"shards {self.shards} hold fewer complete (image, txt) "
+                    f"pairs than one batch of {self.batch_size}"
+                )

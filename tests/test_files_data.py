@@ -1,0 +1,205 @@
+"""Real-data loaders (folder pairs + webdataset-style tar shards)."""
+
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.data.files import (
+    ImageTextFolder,
+    ImageTextShards,
+    decode_and_resize,
+)
+from distributed_sigmoid_loss_tpu.data.tokenizer import ByteTokenizer
+from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+
+def _tok(cfg):
+    """ByteTokenizer folded into the config's vocab (ids exceed tiny vocabs)."""
+    tok = ByteTokenizer()
+
+    def tokenize(texts, length):
+        return np.asarray(tok(texts, length)) % cfg.text.vocab_size
+
+    return tokenize
+
+def _png_bytes(w, h, color):
+    from io import BytesIO
+
+    from PIL import Image
+
+    im = Image.new("RGB", (w, h), color)
+    out = BytesIO()
+    im.save(out, "PNG")
+    return out.getvalue()
+
+
+def _make_folder(tmp_path, n, w=20, h=12):
+    for i in range(n):
+        (tmp_path / f"sample{i:03d}.png").write_bytes(
+            _png_bytes(w, h, (i * 9 % 256, 30, 200))
+        )
+        (tmp_path / f"sample{i:03d}.txt").write_text(f"a photo of thing {i}")
+    return str(tmp_path)
+
+
+def test_decode_and_resize_geometry_and_range():
+    cfg = SigLIPConfig.tiny_test()
+    s = cfg.vision.image_size
+    # Wide, tall, exact, and grayscale inputs all land on (s, s, 3) in [-1, 1].
+    for w, h in [(40, 16), (16, 40), (s, s)]:
+        arr = decode_and_resize(_png_bytes(w, h, (255, 0, 0)), s)
+        assert arr.shape == (s, s, 3) and arr.dtype == np.float32
+        assert -1.0 <= arr.min() and arr.max() <= 1.0
+        # Solid red stays solid red after resize/crop: R=1, G=B=-1.
+        np.testing.assert_allclose(arr[..., 0], 1.0, atol=0.02)
+        np.testing.assert_allclose(arr[..., 1], -1.0, atol=0.02)
+
+    from io import BytesIO
+
+    from PIL import Image
+
+    gray = BytesIO()
+    Image.new("L", (30, 30), 128).save(gray, "PNG")
+    arr = decode_and_resize(gray.getvalue(), s)
+    assert arr.shape == (s, s, 3)
+
+
+def test_folder_batches_and_epoch_cycling(tmp_path):
+    cfg = SigLIPConfig.tiny_test()
+    root = _make_folder(tmp_path, 10)
+    ds = ImageTextFolder(root, cfg, batch_size=4, tokenize=_tok(cfg))
+    assert len(ds) == 10
+    it = iter(ds)
+    seen = [next(it) for _ in range(5)]  # 2 batches/epoch (drop-last) -> cycles
+    s = cfg.vision.image_size
+    for b in seen:
+        assert b["images"].shape == (4, s, s, 3)
+        assert b["tokens"].shape == (4, cfg.text.context_length)
+        assert b["tokens"].dtype == np.int32
+
+
+def test_folder_skips_incomplete_pairs_and_validates(tmp_path):
+    cfg = SigLIPConfig.tiny_test()
+    root = _make_folder(tmp_path, 4)
+    (tmp_path / "orphan.png").write_bytes(_png_bytes(8, 8, (1, 2, 3)))
+    (tmp_path / "textonly.txt").write_text("no image")
+    ds = ImageTextFolder(root, cfg, batch_size=4, tokenize=_tok(cfg))
+    assert len(ds) == 4  # orphans skipped
+    with pytest.raises(ValueError, match="need at least one batch"):
+        ImageTextFolder(root, cfg, batch_size=16, tokenize=_tok(cfg))
+
+
+def test_out_of_vocab_tokens_fail_loudly(tmp_path):
+    """An unfolded ByteTokenizer (ids up to ~258) against the tiny vocab of 64
+    must raise the clear error, not feed NaN-producing ids into nn.Embed."""
+    cfg = SigLIPConfig.tiny_test()
+    root = _make_folder(tmp_path, 4)
+    ds = ImageTextFolder(root, cfg, batch_size=4, tokenize=ByteTokenizer())
+    with pytest.raises(ValueError, match="outside vocab_size"):
+        next(iter(ds))
+
+
+def test_folder_deterministic_given_seed(tmp_path):
+    cfg = SigLIPConfig.tiny_test()
+    root = _make_folder(tmp_path, 8)
+    tok = _tok(cfg)
+    a = next(iter(ImageTextFolder(root, cfg, 4, tok, seed=5)))
+    b = next(iter(ImageTextFolder(root, cfg, 4, tok, seed=5)))
+    np.testing.assert_array_equal(a["images"], b["images"])
+    np.testing.assert_array_equal(a["tokens"], b["tokens"])
+
+
+def _make_shards(tmp_path, n_shards, per_shard):
+    paths = []
+    idx = 0
+    for s in range(n_shards):
+        path = str(tmp_path / f"shard{s:02d}.tar")
+        with tarfile.open(path, "w") as tf:
+            import io
+
+            for _ in range(per_shard):
+                png = _png_bytes(18, 14, (idx * 7 % 256, 90, 10))
+                info = tarfile.TarInfo(f"s{idx:04d}.png")
+                info.size = len(png)
+                tf.addfile(info, io.BytesIO(png))
+                txt = f"caption {idx}".encode()
+                info = tarfile.TarInfo(f"s{idx:04d}.txt")
+                info.size = len(txt)
+                tf.addfile(info, io.BytesIO(txt))
+                idx += 1
+        paths.append(path)
+    return paths
+
+
+def test_shards_stream_batches(tmp_path):
+    cfg = SigLIPConfig.tiny_test()
+    shards = _make_shards(tmp_path, 3, per_shard=4)
+    ds = ImageTextShards(shards, cfg, batch_size=4, tokenize=_tok(cfg))
+    it = iter(ds)
+    s = cfg.vision.image_size
+    for _ in range(4):  # crosses shard boundaries and epochs
+        b = next(it)
+        assert b["images"].shape == (4, s, s, 3)
+        assert b["tokens"].shape == (4, cfg.text.context_length)
+
+
+def test_shards_multihost_striping_disjoint(tmp_path):
+    cfg = SigLIPConfig.tiny_test()
+    shards = _make_shards(tmp_path, 4, per_shard=2)
+    tok = _tok(cfg)
+    host0 = ImageTextShards(shards, cfg, 2, tok, seed=None, shard_index=0, num_shards=2)
+    host1 = ImageTextShards(shards, cfg, 2, tok, seed=None, shard_index=1, num_shards=2)
+    assert set(host0.shards).isdisjoint(host1.shards)
+    assert sorted(host0.shards + host1.shards) == sorted(shards)
+    # Compare images (captions truncate identically at the tiny context length;
+    # the per-sample fill colors are unique).
+    i0 = next(iter(host0))["images"]
+    i1 = next(iter(host1))["images"]
+    assert not np.array_equal(i0, i1)
+
+    with pytest.raises(ValueError, match="no shards"):
+        ImageTextShards([], cfg, 2, tok)
+    with pytest.raises(ValueError, match="received no shards"):
+        ImageTextShards(shards[:1], cfg, 2, tok, shard_index=1, num_shards=2)
+
+
+def test_folder_feeds_train_step(tmp_path):
+    """Real decoded data through the full sharded train step."""
+    import jax
+
+    from distributed_sigmoid_loss_tpu.data.loader import prefetch
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
+
+    cfg = SigLIPConfig.tiny_test()
+    root = _make_folder(tmp_path, 8)
+    ds = ImageTextFolder(root, cfg, batch_size=8, tokenize=_tok(cfg))
+    mesh = make_mesh(4)
+    model = SigLIP(cfg)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+
+    stream = prefetch(iter(ds), mesh, size=2)
+    first = next(stream)
+    state = create_train_state(jax.random.key(0), model, tx, first, mesh)
+    step, _ = make_train_step(model, mesh, LossConfig(variant="ring"))
+    state, metrics = step(state, first)
+    state, metrics = step(state, next(stream))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_shards_too_few_pairs_error_not_hang(tmp_path):
+    """A shard slice with fewer pairs than one batch must raise after the first
+    epoch pass, not spin forever re-reading the tars."""
+    cfg = SigLIPConfig.tiny_test()
+    shards = _make_shards(tmp_path, 1, per_shard=2)
+    ds = ImageTextShards(shards, cfg, batch_size=4, tokenize=_tok(cfg))
+    with pytest.raises(ValueError, match="fewer complete"):
+        next(iter(ds))
