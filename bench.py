@@ -58,7 +58,15 @@ def model_forward_flops_per_pair(cfg) -> float:
     if t.pool == "map":
         txt += 4.0 * t.context_length * t.width * t.width
     txt += 2.0 * t.width * t.embed_dim
-    return vit + txt
+    # MoE: each token runs k expert MLPs of the dense hidden size, so the MLP
+    # term scales by k (router/dispatch einsums are <1% at bench shapes).
+    def moe_extra(tower, s):
+        extra_k = tower.moe_num_selected - 1
+        if not tower.moe_experts or extra_k <= 0:
+            return 0.0
+        return extra_k * 4.0 * tower.mlp_ratio * s * tower.width**2 * tower.depth
+
+    return vit + txt + moe_extra(v, s_img) + moe_extra(t, t.context_length)
 
 
 def main():
@@ -86,6 +94,11 @@ def main():
                          "kept for sweeps at smaller batches)")
     ap.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over dp (ZeRO-1); no-op on 1 chip")
+    ap.add_argument("--moe", type=int, default=0, metavar="E",
+                    help="mixture-of-experts towers with E experts per block "
+                         "(replicated on 1 chip; shard over ep on a pod)")
+    ap.add_argument("--moe-k", type=int, default=1, choices=[1, 2],
+                    help="experts per token (with --moe)")
     ap.add_argument("--scan-layers", action="store_true",
                     help="lax.scan over tower depth instead of the unrolled "
                          "default (O(1) compile time in depth, ~1.3%% slower)")
@@ -93,6 +106,10 @@ def main():
                     help="capture a jax.profiler trace of the timed steps into DIR "
                          "(view with TensorBoard or ui.perfetto.dev)")
     args = ap.parse_args()
+    if args.moe == 1 or args.moe < 0:
+        ap.error(f"--moe must be >= 2 experts (or 0 for dense), got {args.moe}")
+    if args.moe_k != 1 and not args.moe:
+        ap.error("--moe-k without --moe would be a silent no-op")
 
     import jax
     import jax.numpy as jnp
@@ -136,6 +153,16 @@ def main():
         )
     import dataclasses
 
+    if args.moe:
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(
+                cfg.vision, moe_experts=args.moe, moe_num_selected=args.moe_k
+            ),
+            text=dataclasses.replace(
+                cfg.text, moe_experts=args.moe, moe_num_selected=args.moe_k
+            ),
+        )
     if args.no_text_remat:
         cfg = dataclasses.replace(cfg, text=dataclasses.replace(cfg.text, remat=False))
     if not args.scan_layers:
@@ -176,7 +203,8 @@ def main():
         variant=args.variant, precision=args.precision, use_pallas=args.use_pallas
     )
     step, shardings = make_train_step(
-        model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1
+        model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1,
+        moe_aux_weight=0.01 if args.moe else None,
     )
     batch = jax.device_put(batch, shardings)
 
@@ -255,6 +283,9 @@ def main():
     # magnitude low; publishing a 0.06 "hw_util" next to a 0.51 MFU would be noise.
     hw_tflops = None
     record["scan_layers"] = args.scan_layers
+    if args.moe:
+        record["moe_experts"] = args.moe
+        record["moe_num_selected"] = args.moe_k
     if args.zero1:
         record["zero1"] = True
     if args.no_text_remat:

@@ -71,6 +71,26 @@ def _model_config(args):
     return cfg
 
 
+def _byte_tokenize_for(cfg):
+    """ByteTokenizer folded into the config's vocab when it's smaller (tiny test
+    configs): modulo keeps distinct texts distinct, where clamping would
+    collapse them onto the max id. Shared by train (real-data loaders) and eval
+    (zero-shot prompts)."""
+    from distributed_sigmoid_loss_tpu.data import ByteTokenizer
+
+    tok = ByteTokenizer()
+
+    def tokenize(texts, length):
+        import numpy as np
+
+        ids = np.asarray(tok(texts, length))
+        if cfg.text.vocab_size < tok.vocab_size:
+            ids = ids % cfg.text.vocab_size
+        return ids
+
+    return tokenize
+
+
 def cmd_train(args) -> int:
     _bootstrap_devices(args)
     import jax
@@ -179,7 +199,44 @@ def cmd_train(args) -> int:
         )
     )
     source = None
-    if args.native_data:
+    if sum(map(bool, (args.data_dir, args.data_shards, args.native_data))) > 1:
+        print(
+            "--data-dir, --data-shards and --native-data are mutually "
+            "exclusive data sources",
+            file=sys.stderr,
+        )
+        return 2
+    if (args.data_dir or args.data_shards) and pcnt > 1:
+        # Real-data multihost needs per-host shard striping + local-rows
+        # assembly (ImageTextShards(shard_index=...) + global_batch_from_local)
+        # rather than the same-global-batch-everywhere model place() implements;
+        # wire it with the library API, not this convenience entry point.
+        print(
+            "--data-dir/--data-shards are single-process flags; for multi-host "
+            "real-data training use data.ImageTextShards(shard_index=process, "
+            "num_shards=process_count) with data.global_batch_from_local",
+            file=sys.stderr,
+        )
+        return 2
+    if args.data_dir or args.data_shards:
+        from distributed_sigmoid_loss_tpu.data import (
+            ImageTextFolder,
+            ImageTextShards,
+        )
+
+        tokenize = _byte_tokenize_for(cfg)
+        if args.data_dir:
+            source = ImageTextFolder(args.data_dir, cfg, args.batch, tokenize)
+        else:
+            import glob as globmod
+
+            shards = globmod.glob(args.data_shards)
+            if not shards:
+                print(f"--data-shards matched nothing: {args.data_shards!r}",
+                      file=sys.stderr)
+                return 2
+            source = ImageTextShards(shards, cfg, args.batch, tokenize)
+    elif args.native_data:
         from distributed_sigmoid_loss_tpu.data import (
             NativeSyntheticImageText,
             native_available,
@@ -299,7 +356,6 @@ def cmd_eval(args) -> int:
     import numpy as np
 
     from distributed_sigmoid_loss_tpu.data import SyntheticImageText, put_batch
-    from distributed_sigmoid_loss_tpu.data.tokenizer import ByteTokenizer
     from distributed_sigmoid_loss_tpu.eval import (
         retrieval_metrics,
         zeroshot_metrics,
@@ -383,17 +439,8 @@ def cmd_eval(args) -> int:
 
     from distributed_sigmoid_loss_tpu.eval import build_classifier
 
-    tok = ByteTokenizer()
     n_classes = args.classes
-
-    def tokenize(texts, length):
-        ids = tok(texts, length)
-        if cfg.text.vocab_size < tok.vocab_size:
-            # Tiny config: fold byte ids into the toy vocab (demo only; modulo
-            # keeps distinct prompts distinct, where clamping would collapse
-            # them all to the max id and make every class tie).
-            ids = ids % cfg.text.vocab_size
-        return ids
+    tokenize = _byte_tokenize_for(cfg)
 
     classifier = build_classifier(
         partial(model.apply, {"params": params}, method=SigLIP.encode_text),
@@ -457,6 +504,12 @@ def main(argv=None) -> int:
     tr.add_argument("--ep", type=int, default=1,
                     help="expert-parallel mesh factor (with --moe-experts): mesh "
                          "becomes (dp = devices/ep, ep); 1 = replicated experts")
+    tr.add_argument("--data-dir", default="",
+                    help="train on a directory of name.jpg + name.txt pairs "
+                         "(real data; single-process)")
+    tr.add_argument("--data-shards", default="",
+                    help="train on webdataset-style tar shards matching this "
+                         "glob (real data; single-process)")
     tr.add_argument("--native-data", action="store_true",
                     help="use the C++ input-pipeline engine (native/dataloader.cc) "
                          "instead of the numpy pipeline; falls back with a notice "

@@ -251,3 +251,33 @@ def test_train_rejects_orphan_moe_aux_weight_and_bad_ep_zero():
          "--moe-experts", "4", "--ep", "0"]
     )
     assert proc.returncode == 2 and "--ep must be >= 1" in proc.stderr
+
+
+def test_train_on_real_data_dir(tmp_path):
+    """CLI trains on a folder of real (image, caption) pairs."""
+    from PIL import Image
+
+    for i in range(16):
+        Image.new("RGB", (20, 14), (i * 15 % 256, 60, 120)).save(
+            tmp_path / f"p{i:02d}.png"
+        )
+        (tmp_path / f"p{i:02d}.txt").write_text(f"caption number {i}")
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "3", "--batch", "16",
+         "--data-dir", str(tmp_path)]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert [l["step"] for l in lines] == [1, 2, 3]
+
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "1", "--batch", "16",
+         "--data-dir", str(tmp_path), "--native-data"]
+    )
+    assert proc.returncode == 2 and "mutually exclusive" in proc.stderr
+
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "1", "--batch", "16",
+         "--data-shards", str(tmp_path / "nope*.tar")]
+    )
+    assert proc.returncode == 2 and "matched nothing" in proc.stderr
