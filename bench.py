@@ -86,6 +86,12 @@ def main():
                     help="gradient-accumulation microsteps (scan over microbatches); "
                          "batch is the TOTAL per-chip pairs per optimizer step")
     ap.add_argument("--variant", default="ring", choices=["ring", "all_gather"])
+    ap.add_argument("--steps-per-call", type=int, default=1, metavar="K",
+                    help="fuse K optimizer steps into ONE compiled call "
+                         "(lax.fori_loop over the train step) so the host "
+                         "dispatches once per K steps — isolates tunnel/dispatch "
+                         "overhead from device compute; steps must be a multiple "
+                         "of K")
     ap.add_argument("--precision", default="default", choices=["default", "highest"])
     # Perf-experiment knobs (sweep results recorded in docs/PERF.md):
     ap.add_argument("--no-text-remat", action="store_true",
@@ -110,6 +116,9 @@ def main():
         ap.error(f"--moe must be >= 2 experts (or 0 for dense), got {args.moe}")
     if args.moe_k != 1 and not args.moe:
         ap.error("--moe-k without --moe would be a silent no-op")
+    if args.steps_per_call < 1 or args.steps % args.steps_per_call:
+        ap.error(f"steps={args.steps} must be a positive multiple of "
+                 f"--steps-per-call={args.steps_per_call}")
 
     import jax
     import jax.numpy as jnp
@@ -208,6 +217,22 @@ def main():
     )
     batch = jax.device_put(batch, shardings)
 
+    spc = args.steps_per_call
+    if spc > 1:
+        # One compiled call = K full optimizer steps. The jitted inner step
+        # inlines into the fori_loop trace; state keeps its shardings through the
+        # loop carry, and the whole K-step chain is a single device program —
+        # the host dispatches (and the tunnel round-trips) once per K steps.
+        inner = step
+
+        def step_fused(state, batch):
+            st = jax.lax.fori_loop(
+                0, spc - 1, lambda _, s: inner(s, batch)[0], state
+            )
+            return inner(st, batch)
+
+        step = jax.jit(step_fused, donate_argnums=(0,))
+
     # AOT-compile once and reuse the executable for warmup + the timed loop (a
     # second trace-and-compile via the jit cache would double the multi-minute
     # XLA compile on the tunneled chip). cost_analysis() reports the FLOPs of the
@@ -215,12 +240,17 @@ def main():
     # be unavailable on some PJRT backends.
     compiled = step.lower(state, batch).compile()
     hw_flops_per_step_per_dev = None
-    try:
-        cost = compiled.cost_analysis()
-        if cost and cost.get("flops", 0) > 0:
-            hw_flops_per_step_per_dev = float(cost["flops"])
-    except Exception:
-        pass
+    if spc == 1:
+        # Only meaningful unfused: HloCostAnalysis counts a while-loop body
+        # ONCE regardless of trip count, so the fused program's "flops" is
+        # neither K steps' worth nor 1 — skip rather than publish a bogus
+        # hw_util.
+        try:
+            cost = compiled.cost_analysis()
+            if cost and cost.get("flops", 0) > 0:
+                hw_flops_per_step_per_dev = float(cost["flops"])
+        except Exception:
+            pass
 
     # Warmup (compile + first steps). Sync via device->host transfer: on the axon
     # tunnel ``jax.block_until_ready`` returns before execution finishes (measured:
@@ -237,7 +267,7 @@ def main():
     profile_ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
     with profile_ctx:
         t0 = time.perf_counter()
-        for _ in range(args.steps):
+        for _ in range(args.steps // spc):
             state, metrics = compiled(state, batch)
         final_loss = float(metrics["loss"])
         dt = time.perf_counter() - t0
@@ -268,6 +298,7 @@ def main():
         "global_batch": global_b,
         "accum_steps": args.accum,
         "steps": args.steps,
+        "steps_per_call": spc,
         "variant": args.variant,
         "precision": args.precision,
         "use_pallas": args.use_pallas,

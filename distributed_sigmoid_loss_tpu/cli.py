@@ -2,13 +2,16 @@
 
 The reference has no CLI (its entry points are test-file ``__main__`` blocks,
 /root/reference/test_distributed_sigmoid_loss.py:144-148); a framework needs one.
-Three subcommands tie the subsystems together:
+Four subcommands tie the subsystems together:
 
 - ``train`` — end-to-end SigLIP training on synthetic data: mesh, towers,
   distributed sigmoid loss (all-gather or ring), optax, metrics logging,
   preemption-safe checkpointing (``--ckpt-dir``).
 - ``eval``  — zero-shot retrieval + classification of a (random-init or
   checkpointed) model on held-out synthetic data.
+- ``export`` — AOT-export a lowered train/forward step to a StableHLO artifact
+  (``jax.export``): deployable without model code, replayable on a matching
+  topology.
 - ``bench`` — the headline throughput benchmark (delegates to bench.py when run
   from a repo checkout; the measured JSON contract is documented there).
 
@@ -463,6 +466,124 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def cmd_export(args) -> int:
+    """AOT-export a lowered step (train or forward) to a StableHLO artifact.
+
+    The artifact replays with ``jax.export.deserialize(...).call(...)`` on a
+    matching device topology — no model code needed at load time. ``--check``
+    reloads the written file and replays one step against the live jitted step.
+    """
+    _bootstrap_devices(args)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_sigmoid_loss_tpu.data import SyntheticImageText
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        export_step,
+        load_exported,
+        make_optimizer,
+        make_train_step,
+        save_exported,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
+
+    cfg = _model_config(args)
+    model = SigLIP(cfg)
+    n_dev = len(jax.devices())
+    if args.ep > 1:
+        # Same topology rules as `train --ep` (the artifact must match the mesh
+        # the deployed job actually runs — an ep-sharded state cannot replay a
+        # replicated-experts program).
+        from distributed_sigmoid_loss_tpu.models.moe import EP_AXIS
+        from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis, make_2d_mesh
+
+        if not args.moe_experts:
+            print("--ep > 1 requires --moe-experts", file=sys.stderr)
+            return 2
+        if n_dev % args.ep or args.moe_experts % args.ep:
+            print(
+                f"--ep {args.ep} must divide both device count {n_dev} and "
+                f"--moe-experts {args.moe_experts}",
+                file=sys.stderr,
+            )
+            return 2
+        mesh = make_2d_mesh(n_dev // args.ep, args.ep, axis_names=(data_axis, EP_AXIS))
+    else:
+        mesh = make_mesh(n_dev)
+
+    b = args.batch
+    batch = next(iter(SyntheticImageText(cfg, b)))
+
+    if args.what == "train_step":
+        # The schedule is baked into the artifact — it must match what `train`
+        # would run, or the deployed program trains on the wrong LR curve.
+        tx = make_optimizer(
+            TrainConfig(
+                learning_rate=args.lr,
+                warmup_steps=args.warmup_steps,
+                total_steps=args.total_steps,
+            )
+        )
+        state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+        moe_aux = 0.01 if args.moe_experts else None
+        step, shardings = make_train_step(
+            model, mesh, LossConfig(variant=args.variant), moe_aux_weight=moe_aux
+        )
+        batch = jax.device_put(batch, shardings)
+        example = (state, batch)
+        fn = step
+    else:  # forward
+        from flax import linen as nn
+
+        params = nn.meta.unbox(
+            model.init(jax.random.key(0), batch["images"], batch["tokens"])[
+                "params"
+            ]
+        )
+
+        def fn(params, images, tokens):
+            zimg, ztxt, _ = model.apply({"params": params}, images, tokens)
+            return zimg, ztxt
+
+        example = (params, batch["images"], batch["tokens"])
+
+    platforms = (args.platform,) if args.platform else None
+    exported = export_step(fn, example, platforms=platforms)
+    save_exported(args.out, exported)
+    size = os.path.getsize(args.out)
+    model_name = "tiny" if args.tiny else args.model
+    print(
+        f"exported {args.what} ({model_name}, batch {b}, {n_dev} device(s)) "
+        f"-> {args.out} ({size} bytes)"
+    )
+
+    if args.check:
+        if args.platform and args.platform != jax.default_backend():
+            print(
+                f"--check skipped: artifact targets {args.platform!r}, current "
+                f"backend is {jax.default_backend()!r}",
+                file=sys.stderr,
+            )
+            return 0
+        loaded = load_exported(args.out)
+        # Flat calling convention (see train/export.py); the live train step
+        # donates its state argument, so replay the artifact on copies first.
+        got = loaded.call(*jax.tree.leaves(jax.tree.map(jnp.copy, example)))
+        want = fn(*example)
+        want_leaves = jax.tree.leaves(want)
+        assert len(want_leaves) == len(got)
+        for w, g in zip(want_leaves, got):
+            np.testing.assert_allclose(
+                np.asarray(w), np.asarray(g), rtol=1e-5, atol=1e-6
+            )
+        print("check ok: reloaded artifact replays identically")
+    return 0
+
+
 def cmd_bench(extra: list[str]) -> int:
     if any(a == "--cpu-devices" or a.startswith("--cpu-devices=") for a in extra):
         print(
@@ -549,6 +670,40 @@ def main(argv=None) -> int:
     ev.add_argument("--ema", action="store_true",
                     help="evaluate the checkpoint's EMA weights (train --ema-decay)")
 
+    ex = sub.add_parser(
+        "export",
+        help="AOT-export a lowered step to a StableHLO artifact (jax.export)",
+    )
+    ex.add_argument("out", help="output artifact path")
+    ex.add_argument("--what", choices=["train_step", "forward"],
+                    default="train_step")
+    ex.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"],
+                    default="b16")
+    ex.add_argument("--tiny", action="store_true", help="alias for --model tiny")
+    ex.add_argument("--moe-experts", type=int, default=0,
+                    help="export the MoE variant (matches train --moe-experts)")
+    ex.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel mesh factor (with --moe-experts): the "
+                         "artifact is lowered for a (dp = devices/ep, ep) mesh, "
+                         "matching train --ep")
+    ex.add_argument("--batch", type=int, default=64,
+                    help="global batch the artifact is shaped for")
+    ex.add_argument("--variant", choices=["all_gather", "ring"], default="ring")
+    ex.add_argument("--lr", type=float, default=1e-3,
+                    help="learning rate baked into the train_step artifact")
+    ex.add_argument("--warmup-steps", type=int, default=2000,
+                    help="LR warmup steps baked into the train_step artifact")
+    ex.add_argument("--total-steps", type=int, default=100_000,
+                    help="LR schedule horizon baked into the train_step artifact")
+    ex.add_argument("--platform", default="",
+                    help="lowering target (e.g. tpu) when exporting from a "
+                         "different host backend; default: current backend")
+    ex.add_argument("--check", action="store_true",
+                    help="reload the written artifact and replay one step "
+                         "against the live jitted step")
+    ex.add_argument("--cpu-devices", type=int, default=0,
+                    help="emulate N CPU devices (export for an N-device mesh)")
+
     bn = sub.add_parser(
         "bench", help="headline throughput benchmark (extra args pass through)"
     )
@@ -565,6 +720,7 @@ def main(argv=None) -> int:
     dispatch = {
         "train": cmd_train,
         "eval": cmd_eval,
+        "export": cmd_export,
         "bench": lambda a: cmd_bench(a.rest),
     }
     return dispatch[args.cmd](args)

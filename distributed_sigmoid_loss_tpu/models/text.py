@@ -42,7 +42,8 @@ class TextTransformer(nn.Module):
             sp_axis=cfg.sequence_parallel_axis, sp_impl=cfg.sequence_parallel_impl,
             causal=cfg.causal, moe_experts=cfg.moe_experts,
             moe_num_selected=cfg.moe_num_selected,
-            moe_capacity_factor=cfg.moe_capacity_factor, name="encoder",
+            moe_capacity_factor=cfg.moe_capacity_factor,
+            moe_group_size=cfg.moe_group_size, name="encoder",
         )(x)
 
         if cfg.pool == "map":

@@ -225,6 +225,7 @@ class Block(nn.Module):
     moe_experts: int = 0
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
+    moe_group_size: int = 512
 
     @nn.compact
     def __call__(self, x):
@@ -241,6 +242,7 @@ class Block(nn.Module):
                 self.width, self.mlp_ratio, self.moe_experts, self.dtype,
                 num_selected=self.moe_num_selected,
                 capacity_factor=self.moe_capacity_factor,
+                group_size=self.moe_group_size,
                 name="moe",
             )
         else:
@@ -263,6 +265,7 @@ class _ScanBody(nn.Module):
     moe_experts: int = 0
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
+    moe_group_size: int = 512
 
     @nn.compact
     def __call__(self, carry, _):
@@ -273,6 +276,7 @@ class _ScanBody(nn.Module):
             moe_experts=self.moe_experts,
             moe_num_selected=self.moe_num_selected,
             moe_capacity_factor=self.moe_capacity_factor,
+            moe_group_size=self.moe_group_size,
             name="block",
         )(carry)
         return carry, None
@@ -299,6 +303,7 @@ class Encoder(nn.Module):
     moe_experts: int = 0
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
+    moe_group_size: int = 512
 
     @nn.compact
     def __call__(self, x):
@@ -306,6 +311,7 @@ class Encoder(nn.Module):
             moe_experts=self.moe_experts,
             moe_num_selected=self.moe_num_selected,
             moe_capacity_factor=self.moe_capacity_factor,
+            moe_group_size=self.moe_group_size,
         )
         if self.scan_layers:
             body_cls = _ScanBody

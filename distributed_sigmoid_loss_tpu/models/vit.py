@@ -49,7 +49,8 @@ class ViT(nn.Module):
             remat=cfg.remat, scan_layers=cfg.scan_layers, attn_impl=cfg.attn_impl,
             remat_policy=cfg.remat_policy, moe_experts=cfg.moe_experts,
             moe_num_selected=cfg.moe_num_selected,
-            moe_capacity_factor=cfg.moe_capacity_factor, name="encoder",
+            moe_capacity_factor=cfg.moe_capacity_factor,
+            moe_group_size=cfg.moe_group_size, name="encoder",
         )(x)
 
         if cfg.pool == "map":

@@ -17,6 +17,11 @@ from distributed_sigmoid_loss_tpu.train.resilience import (  # noqa: F401
     save_step,
     train_resilient,
 )
+from distributed_sigmoid_loss_tpu.train.export import (  # noqa: F401
+    export_step,
+    load_exported,
+    save_exported,
+)
 from distributed_sigmoid_loss_tpu.train.ema import (  # noqa: F401
     ema_decay_schedule,
     init_ema,

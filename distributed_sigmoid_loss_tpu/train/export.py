@@ -1,0 +1,146 @@
+"""AOT export of jitted steps — serialize the compiled program, not the Python.
+
+The reference's runtime story is torch eager + Gloo process groups: the model
+code must be importable and re-executed on every host that runs it. The XLA-era
+equivalent is shipping the *program*: trace + lower a jitted step once, write
+the StableHLO artifact to disk, and later — possibly in a process that never
+imports the model definition at all — deserialize and call it. That is what
+this module wraps (`jax.export`):
+
+- :func:`export_step` — lower a function at example/abstract arguments and
+  return an :class:`ExportedStep`. Sharding annotations ride along: exports
+  taken over a Mesh replay on a same-shaped mesh.
+- :func:`save_exported` / :func:`load_exported` — the on-disk artifact. The
+  serialized form is versioned StableHLO with jax's export-compatibility
+  guarantee across point releases.
+
+**Calling convention is flat.** Train states carry static fields that are
+Python functions (``apply_fn``, the optax transform), which no serialization
+can ship; the artifact therefore takes the pytree *leaves* positionally and
+returns the result leaves as a tuple. In the exporting process,
+:meth:`ExportedStep.call` keeps the structured signature (it re-flattens /
+unflattens around the artifact). A consumer of the serialized file calls
+``load_exported(path).call(*jax.tree.leaves((args...,)))`` and — exactly like
+any deployed compiled program — interprets the output positions itself.
+
+Typical use: a trainer host exports the train step for the pod topology; worker
+images carry only the runtime deps + the artifact. Also compile-once CI: export
+the dryrun topology's step on a dev machine, replay it byte-identically
+elsewhere.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.export
+
+__all__ = [
+    "ExportedStep",
+    "export_step",
+    "load_exported",
+    "save_exported",
+]
+
+
+def _abstractify(leaves: Sequence[Any]) -> list[jax.ShapeDtypeStruct]:
+    """Concrete arrays → ShapeDtypeStructs carrying their MESH shardings;
+    abstract leaves (ShapeDtypeStruct) pass through, so callers can mix both.
+
+    Single-device placements are deliberately dropped: an uncommitted array's
+    ``SingleDeviceSharding`` is placement history, not user intent, and pinning
+    it would make the lowering reject functions that shard_map over a mesh
+    ("incompatible devices") — let jit place unsharded args instead.
+    """
+
+    def one(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and len(sharding.device_set) < 2:
+            sharding = None
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    return [one(x) for x in leaves]
+
+
+@dataclass(frozen=True)
+class ExportedStep:
+    """A lowered step plus the pytree structure of its boundary.
+
+    ``exported`` is the serializable ``jax.export.Exported`` (flat calling
+    convention); ``in_tree`` / ``out_tree`` recover the structured signature in
+    the exporting process via :meth:`call`. Only ``exported`` survives
+    :func:`save_exported` — structure is Python-side knowledge, exactly like
+    the parameter layout of any deployed compiled program.
+    """
+
+    exported: jax.export.Exported
+    in_tree: Any
+    out_tree: Any
+
+    def call(self, *args):
+        """Structured call: same signature as the original function."""
+        leaves = jax.tree.leaves(tuple(args))
+        out_leaves = self.exported.call(*leaves)
+        return jax.tree.unflatten(self.out_tree, out_leaves)
+
+    def serialize(self) -> bytearray:
+        return self.exported.serialize()
+
+
+def export_step(
+    fn: Callable,
+    example_args: Sequence[Any],
+    *,
+    platforms: Sequence[str] | None = None,
+) -> ExportedStep:
+    """Lower ``fn`` at ``example_args`` and return the serializable artifact.
+
+    ``fn`` may be jitted or plain (plain functions are jitted here); its args
+    and results may be arbitrary pytrees — including train states whose static
+    fields (functions) could never serialize — because the export boundary is
+    the flat leaf sequence. ``example_args`` leaves may be concrete arrays
+    (shapes/dtypes/shardings are used; values are not) or ``ShapeDtypeStruct``.
+    ``platforms`` pins the lowering targets (e.g. ``("tpu",)`` to export for
+    TPU from a CPU host); default is the current backend.
+    """
+    flat, in_tree = jax.tree.flatten(tuple(example_args))
+    out_tree_box: list[Any] = []
+
+    def flat_fn(*leaves):
+        args = jax.tree.unflatten(in_tree, leaves)
+        out = fn(*args)
+        out_leaves, out_tree = jax.tree.flatten(out)
+        out_tree_box.append(out_tree)
+        return tuple(out_leaves)
+
+    kwargs = {"platforms": tuple(platforms)} if platforms else {}
+    exported = jax.export.export(jax.jit(flat_fn), **kwargs)(*_abstractify(flat))
+    return ExportedStep(exported, in_tree, out_tree_box[0])
+
+
+def save_exported(path: str, exported: ExportedStep | jax.export.Exported) -> None:
+    """Write the versioned serialized artifact to ``path``."""
+    data = exported.serialize()
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def load_exported(path: str) -> jax.export.Exported:
+    """Read an artifact written by :func:`save_exported`.
+
+    Returns the raw ``Exported`` (flat calling convention — see module
+    docstring); run it with ``.call(*leaves)`` on a device topology matching
+    the export's. An artifact exported over an N-device mesh must be called
+    with args placed on N devices (e.g. ``jax.device_put`` with a
+    ``NamedSharding`` of a same-shape mesh — replicated specs are fine);
+    single-device arrays make the call context 1-device and jax rejects the
+    replay. ``.call`` is traceable, so the loaded program can be embedded
+    inside a larger jitted computation.
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    return jax.export.deserialize(bytearray(data))

@@ -57,6 +57,9 @@ class ViTConfig:
     moe_experts: int = 0
     moe_num_selected: int = 1  # 1 = Switch top-1, 2 = top-2 with renormalized gates
     moe_capacity_factor: float = 1.25
+    # Routing group size (GShard groups): capacity is per-group, keeping the
+    # dispatch tensors O(tokens*E*C_group); tune down for tight HBM budgets.
+    moe_group_size: int = 512
 
     @classmethod
     def vit_b16(cls, **kw) -> "ViTConfig":
@@ -104,6 +107,7 @@ class TextConfig:
     moe_experts: int = 0
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
+    moe_group_size: int = 512
 
     @classmethod
     def base(cls, **kw) -> "TextConfig":
