@@ -100,11 +100,18 @@ def main():
                          "kept for sweeps at smaller batches)")
     ap.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over dp (ZeRO-1); no-op on 1 chip")
+    ap.add_argument("--mu-bf16", action="store_true",
+                    help="bf16 Adam first moment (halves that buffer; the cheap "
+                         "end of the optimizer-memory ladder before ZeRO-1)")
     ap.add_argument("--moe", type=int, default=0, metavar="E",
                     help="mixture-of-experts towers with E experts per block "
                          "(replicated on 1 chip; shard over ep on a pod)")
     ap.add_argument("--moe-k", type=int, default=1, choices=[1, 2],
                     help="experts per token (with --moe)")
+    ap.add_argument("--moe-group-size", type=int, default=0, metavar="G",
+                    help="GShard routing group size (with --moe; default 512): "
+                         "capacity is per-group, so smaller groups shrink the "
+                         "dispatch tensors for tight HBM budgets")
     ap.add_argument("--scan-layers", action="store_true",
                     help="lax.scan over tower depth instead of the unrolled "
                          "default (O(1) compile time in depth, ~1.3%% slower)")
@@ -163,14 +170,13 @@ def main():
     import dataclasses
 
     if args.moe:
+        moe_kw = {"moe_experts": args.moe, "moe_num_selected": args.moe_k}
+        if args.moe_group_size:
+            moe_kw["moe_group_size"] = args.moe_group_size
         cfg = dataclasses.replace(
             cfg,
-            vision=dataclasses.replace(
-                cfg.vision, moe_experts=args.moe, moe_num_selected=args.moe_k
-            ),
-            text=dataclasses.replace(
-                cfg.text, moe_experts=args.moe, moe_num_selected=args.moe_k
-            ),
+            vision=dataclasses.replace(cfg.vision, **moe_kw),
+            text=dataclasses.replace(cfg.text, **moe_kw),
         )
     if args.no_text_remat:
         cfg = dataclasses.replace(cfg, text=dataclasses.replace(cfg.text, remat=False))
@@ -184,7 +190,13 @@ def main():
             text=dataclasses.replace(cfg.text, scan_layers=False),
         )
     model = SigLIP(cfg)
-    tx = make_optimizer(TrainConfig(warmup_steps=100, total_steps=100_000))
+    tx = make_optimizer(
+        TrainConfig(
+            warmup_steps=100,
+            total_steps=100_000,
+            adam_mu_dtype="bfloat16" if args.mu_bf16 else None,
+        )
+    )
 
     global_b = args.batch * n_dev
 
@@ -319,6 +331,8 @@ def main():
         record["moe_num_selected"] = args.moe_k
     if args.zero1:
         record["zero1"] = True
+    if args.mu_bf16:
+        record["adam_mu_dtype"] = "bfloat16"
     if args.no_text_remat:
         record["no_text_remat"] = True
     if hw_flops_per_step_per_dev is not None:

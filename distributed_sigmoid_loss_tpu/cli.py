@@ -66,12 +66,52 @@ def _model_config(args):
             raise SystemExit(f"--moe-experts must be >= 2, got {moe}")
         import dataclasses
 
+        group = getattr(args, "moe_group_size", 0)
+        tower_kw = {"moe_experts": moe}
+        if group:
+            if group < 1:
+                raise SystemExit(f"--moe-group-size must be >= 1, got {group}")
+            tower_kw["moe_group_size"] = group
         cfg = dataclasses.replace(
             cfg,
-            vision=dataclasses.replace(cfg.vision, moe_experts=moe),
-            text=dataclasses.replace(cfg.text, moe_experts=moe),
+            vision=dataclasses.replace(cfg.vision, **tower_kw),
+            text=dataclasses.replace(cfg.text, **tower_kw),
         )
+    elif getattr(args, "moe_group_size", 0):
+        raise SystemExit("--moe-group-size without --moe-experts is a no-op")
     return cfg
+
+
+def _make_training_mesh(args):
+    """The (dp[, ep]) mesh for ``--ep`` topologies — ONE set of rules shared by
+    train and export (an artifact validated under different rules than the job
+    it deploys to is exactly the drift this helper prevents).
+
+    Returns ``(mesh, None)`` or ``(None, error_message)``.
+    """
+    import jax
+
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+    if args.ep <= 1:
+        return make_mesh(), None
+    from distributed_sigmoid_loss_tpu.models.moe import EP_AXIS
+    from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis, make_2d_mesh
+
+    n_dev = len(jax.devices())
+    if not args.moe_experts:
+        return None, (
+            "--ep > 1 without --moe-experts would only shrink data "
+            "parallelism (a dense model has no ep-sharded params)"
+        )
+    if n_dev % args.ep:
+        return None, f"--ep {args.ep} must divide device count {n_dev}"
+    if args.moe_experts % args.ep:
+        return None, (
+            f"--ep {args.ep} must divide --moe-experts {args.moe_experts} "
+            f"(expert kernels are stacked (E, ...) and sharded over ep)"
+        )
+    return make_2d_mesh(n_dev // args.ep, args.ep, axis_names=(data_axis, EP_AXIS)), None
 
 
 def _byte_tokenize_for(cfg):
@@ -137,7 +177,6 @@ def cmd_train(args) -> int:
         global_batch_from_local,
     )
     from distributed_sigmoid_loss_tpu.models import SigLIP
-    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
     from distributed_sigmoid_loss_tpu.train import (
         PreemptionGuard,
         create_train_state,
@@ -160,34 +199,10 @@ def cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.ep > 1:
-        from distributed_sigmoid_loss_tpu.models.moe import EP_AXIS
-        from distributed_sigmoid_loss_tpu.parallel.mesh import (
-            data_axis,
-            make_2d_mesh,
-        )
-
-        n_dev = len(jax.devices())
-        if not args.moe_experts:
-            print(
-                "--ep > 1 without --moe-experts would only shrink data "
-                "parallelism (a dense model has no ep-sharded params)",
-                file=sys.stderr,
-            )
-            return 2
-        if n_dev % args.ep:
-            print(f"--ep {args.ep} must divide device count {n_dev}", file=sys.stderr)
-            return 2
-        if args.moe_experts % args.ep:
-            print(
-                f"--ep {args.ep} must divide --moe-experts {args.moe_experts} "
-                f"(expert kernels are stacked (E, ...) and sharded over ep)",
-                file=sys.stderr,
-            )
-            return 2
-        mesh = make_2d_mesh(n_dev // args.ep, args.ep, axis_names=(data_axis, EP_AXIS))
-    else:
-        mesh = make_mesh()
+    mesh, mesh_err = _make_training_mesh(args)
+    if mesh_err:
+        print(mesh_err, file=sys.stderr)
+        return 2
     pidx, pcnt = jax.process_index(), jax.process_count()
     print(
         f"mesh: {dict(mesh.shape)} devices={len(jax.devices())}"
@@ -480,7 +495,6 @@ def cmd_export(args) -> int:
 
     from distributed_sigmoid_loss_tpu.data import SyntheticImageText
     from distributed_sigmoid_loss_tpu.models import SigLIP
-    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
     from distributed_sigmoid_loss_tpu.train import (
         create_train_state,
         export_step,
@@ -494,33 +508,24 @@ def cmd_export(args) -> int:
     cfg = _model_config(args)
     model = SigLIP(cfg)
     n_dev = len(jax.devices())
-    if args.ep > 1:
-        # Same topology rules as `train --ep` (the artifact must match the mesh
-        # the deployed job actually runs — an ep-sharded state cannot replay a
-        # replicated-experts program).
-        from distributed_sigmoid_loss_tpu.models.moe import EP_AXIS
-        from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis, make_2d_mesh
-
-        if not args.moe_experts:
-            print("--ep > 1 requires --moe-experts", file=sys.stderr)
-            return 2
-        if n_dev % args.ep or args.moe_experts % args.ep:
-            print(
-                f"--ep {args.ep} must divide both device count {n_dev} and "
-                f"--moe-experts {args.moe_experts}",
-                file=sys.stderr,
-            )
-            return 2
-        mesh = make_2d_mesh(n_dev // args.ep, args.ep, axis_names=(data_axis, EP_AXIS))
-    else:
-        mesh = make_mesh(n_dev)
+    if args.what == "forward" and args.ep > 1:
+        # The forward export takes freshly-init'd (unsharded) params and never
+        # touches the mesh; silently accepting --ep would emit a 1-device
+        # program while the flags promise an expert-parallel one.
+        print("--ep applies to --what train_step only (the forward export is "
+              "a single-device inference program)", file=sys.stderr)
+        return 2
+    mesh, mesh_err = _make_training_mesh(args)  # same topology rules as train
+    if mesh_err:
+        print(mesh_err, file=sys.stderr)
+        return 2
 
     b = args.batch
     batch = next(iter(SyntheticImageText(cfg, b)))
 
     if args.what == "train_step":
-        # The schedule is baked into the artifact — it must match what `train`
-        # would run, or the deployed program trains on the wrong LR curve.
+        # The schedule + aux weight are baked into the artifact — export the
+        # values the deployed job will actually train with (--lr etc.).
         tx = make_optimizer(
             TrainConfig(
                 learning_rate=args.lr,
@@ -529,7 +534,7 @@ def cmd_export(args) -> int:
             )
         )
         state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
-        moe_aux = 0.01 if args.moe_experts else None
+        moe_aux = args.moe_aux_weight if args.moe_experts else None
         step, shardings = make_train_step(
             model, mesh, LossConfig(variant=args.variant), moe_aux_weight=moe_aux
         )
@@ -622,6 +627,10 @@ def main(argv=None) -> int:
     tr.add_argument("--moe-aux-weight", type=float, default=None,
                     help="router load-balancing loss weight (requires "
                          "--moe-experts; default 0.01 when MoE is on)")
+    tr.add_argument("--moe-group-size", type=int, default=0,
+                    help="GShard routing group size (with --moe-experts): "
+                         "capacity is per-group, so smaller groups shrink the "
+                         "dispatch tensors for tight HBM budgets (default 512)")
     tr.add_argument("--ep", type=int, default=1,
                     help="expert-parallel mesh factor (with --moe-experts): mesh "
                          "becomes (dp = devices/ep, ep); 1 = replicated experts")
@@ -685,7 +694,13 @@ def main(argv=None) -> int:
     ex.add_argument("--ep", type=int, default=1,
                     help="expert-parallel mesh factor (with --moe-experts): the "
                          "artifact is lowered for a (dp = devices/ep, ep) mesh, "
-                         "matching train --ep")
+                         "matching train --ep (train_step only)")
+    ex.add_argument("--moe-aux-weight", type=float, default=0.01,
+                    help="router load-balancing loss weight baked into the "
+                         "train_step artifact (match the train job's value)")
+    ex.add_argument("--moe-group-size", type=int, default=0,
+                    help="GShard routing group size baked into the artifact "
+                         "(match the train job's value; default 512)")
     ex.add_argument("--batch", type=int, default=64,
                     help="global batch the artifact is shaped for")
     ex.add_argument("--variant", choices=["all_gather", "ring"], default="ring")

@@ -81,7 +81,13 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         raise ValueError(f"unknown schedule: {cfg.schedule!r}")
     return optax.chain(
         optax.clip_by_global_norm(1.0),
-        optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
+        optax.adamw(
+            schedule,
+            b1=cfg.b1,
+            b2=cfg.b2,
+            weight_decay=cfg.weight_decay,
+            mu_dtype=cfg.adam_mu_dtype,
+        ),
     )
 
 

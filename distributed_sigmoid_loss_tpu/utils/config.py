@@ -174,3 +174,8 @@ class TrainConfig:
     # sqrt with linear warmup — total_steps-free, for open-ended pretraining),
     # or "constant" (after warmup).
     schedule: Literal["warmup_cosine", "rsqrt", "constant"] = "warmup_cosine"
+    # Dtype of Adam's first moment (None = param dtype, f32). "bfloat16" halves
+    # the larger moment buffer — ~1.75 GB on so400m — the cheap end of the
+    # optimizer-memory ladder before ZeRO-1; the second moment stays f32 (its
+    # wide dynamic range is what bf16's 8 mantissa bits lose first).
+    adam_mu_dtype: str | None = None

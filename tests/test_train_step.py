@@ -194,3 +194,50 @@ def test_grad_accumulation_rejects_indivisible_batch():
     step3, shardings = make_train_step(model, mesh, LossConfig(variant="ring"), accum_steps=3)
     with pytest.raises(ValueError, match="accum_steps"):
         step3(state, jax.device_put(batch, shardings))
+
+
+def test_bf16_adam_moments_track_f32_and_halve_dtype():
+    """`TrainConfig.adam_mu_dtype="bfloat16"` stores the first moment in bf16
+    (the memory contract) while the resulting update stays close to the f32
+    optimizer's over a few steps (the numerics contract)."""
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    mesh = make_mesh(4)
+    batch = tiny_batch(16, cfg)
+
+    def run(mu_dtype):
+        tx = make_optimizer(
+            TrainConfig(warmup_steps=1, total_steps=10, adam_mu_dtype=mu_dtype)
+        )
+        state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+        step, shardings = make_train_step(model, mesh, LossConfig(variant="ring"))
+        b = jax.device_put(batch, shardings)
+        for _ in range(3):
+            state, metrics = step(state, b)
+        return state, float(metrics["loss"])
+
+    s32, l32 = run(None)
+    s16, l16 = run("bfloat16")
+
+    # First-moment dtype: walk each opt_state for the adam moments.
+    import optax
+
+    def adam_state(s):
+        for x in jax.tree.leaves(
+            s.opt_state, is_leaf=lambda n: isinstance(n, optax.ScaleByAdamState)
+        ):
+            if isinstance(x, optax.ScaleByAdamState):
+                return x
+        raise AssertionError("no ScaleByAdamState found")
+
+    assert all(m.dtype == jnp.float32 for m in jax.tree.leaves(adam_state(s32).mu))
+    assert all(m.dtype == jnp.bfloat16 for m in jax.tree.leaves(adam_state(s16).mu))
+    # nu stays f32 in both (bf16 loses its dynamic range first).
+    assert all(n.dtype == jnp.float32 for n in jax.tree.leaves(adam_state(s16).nu))
+
+    np.testing.assert_allclose(l16, l32, rtol=5e-3)
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(s32.params)),
+        jax.tree.leaves(jax.device_get(s16.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=0.05, atol=2e-4)
