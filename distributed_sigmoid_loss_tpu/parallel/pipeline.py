@@ -43,11 +43,16 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from distributed_sigmoid_loss_tpu.parallel.collectives import pvary, ring_shift_right
+from distributed_sigmoid_loss_tpu.parallel.collectives import (
+    pvary,
+    ring_shift_left,
+    ring_shift_right,
+)
 
 __all__ = [
     "pipeline_axis",
     "gpipe",
+    "one_f_one_b",
     "stack_stage_params",
     "make_layer_stage_fn",
 ]
@@ -166,5 +171,143 @@ def gpipe(
         mesh=mesh,
         in_specs=(P(axis_name), P()),
         out_specs=P(),
+        axis_names={axis_name},
+    )(stage_params, microbatches)
+
+
+def one_f_one_b(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    loss_fn: Callable[[jax.Array], jax.Array],
+    *,
+    mesh: Mesh,
+    axis_name: str = pipeline_axis,
+) -> tuple[jax.Array, Any]:
+    """1F1B pipeline training step: ``(mean loss, stage-param grads)``.
+
+    :func:`gpipe` + autodiff is GPipe also in *memory*: the forward scan saves
+    every microbatch's stage boundary, so activation memory grows O(M). This
+    schedule hand-orchestrates the backward instead — each global tick runs ONE
+    forward and ONE backward sub-tick on every stage (the 1F1B steady state),
+    and a stage keeps a forward input stashed only until its own backward
+    consumes it. The stash is a ring buffer of static depth ``2S-1``:
+    activation memory is O(S), independent of M — the property that lets
+    M ≫ S shrink the bubble without growing HBM.
+
+    Schedule (stage s, microbatch m, S stages, global tick u):
+
+    - forward of m at s:   u = m + s
+    - backward of m at s:  u = m + 2(S-1) - s  (uniform S-1-tick backward
+      delay; at the LAST stage forward and backward of a microbatch share a
+      tick, so the loss cotangent seeds the backward stream with no stash)
+    - stash residence at s: 2(S-1-s) ticks  →  depth 2S-1 covers every stage
+    - total ticks: M + 2(S-1); per-tick work = 1 fwd + 1 bwd (the backward
+      sub-tick re-runs the stage forward under ``jax.vjp`` — same recompute
+      trade as ``gpipe(checkpoint_stages=True)``)
+
+    Cotangents ride the reverse ring (``ppermute`` left) exactly like the
+    reference's backward neighbour exchange (distributed_utils.py:74-77);
+    here it is explicit because the schedule, not autodiff, owns the backward.
+
+    Args:
+      stage_fn: ``(per_stage_params, x) -> y``, ``y.shape == x.shape``.
+      stage_params: (S, ...)-leading pytree sharded over ``axis_name``.
+      microbatches: ``(M, mb, ...)``; every microbatch must be full-shape.
+      loss_fn: ``y -> scalar`` applied to each LAST-stage output; the returned
+        loss (and grads) are the mean over the M microbatches.
+
+    Returns:
+      ``(loss, grads)``: scalar mean loss (replicated) and a grads pytree
+      shaped/sharded like ``stage_params``.
+    """
+    num_stages = mesh.shape[axis_name]
+    num_micro = microbatches.shape[0]
+    stash_depth = 2 * num_stages - 1
+    total_ticks = num_micro + 2 * (num_stages - 1)
+
+    def device_fn(params, xs):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        stage = lax.axis_index(axis_name)
+        xs = pvary(xs, axis_name)
+        mb_shape = xs.shape[1:]
+
+        # Every carry starts device-varying (pvary): the body mixes in
+        # stage-dependent data, and scan requires carry-in/out vma types match.
+        act0 = pvary(jnp.zeros(mb_shape, xs.dtype), axis_name)
+        cot0 = pvary(jnp.zeros(mb_shape, xs.dtype), axis_name)
+        stash0 = pvary(jnp.zeros((stash_depth,) + mb_shape, xs.dtype), axis_name)
+        # (zeros_like params is already varying — params arrive pp-sharded.)
+        gacc0 = jax.tree.map(jnp.zeros_like, params)
+        loss0 = pvary(jnp.zeros((), jnp.float32), axis_name)
+
+        def tick(carry, u):
+            act, cot, stash, gacc, loss_acc = carry
+
+            # ---- forward sub-tick: mb m_f = u - stage ----------------------
+            m_f = u - stage
+            f_valid = (m_f >= 0) & (m_f < num_micro)
+            received = ring_shift_right(act, axis_name)
+            feed = lax.dynamic_index_in_dim(
+                xs, jnp.clip(m_f, 0, num_micro - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(stage == 0, feed, received)
+            y = stage_fn(params, x_in)
+            act_next = y
+            # Stash this tick's stage input for our own backward sub-tick
+            # (possibly THIS tick, at the last stage). Invariant that makes the
+            # unmasked write safe on drain ticks (m_f >= M): the clipped index
+            # re-targets slot (M-1) % depth AND the clipped stage-0 feed (plus
+            # upstream stages re-running the same inputs) makes x_in a bitwise
+            # recompute of microbatch M-1's boundary — re-writing identical
+            # bytes over a slot whose backward may still be pending. Zeroing or
+            # otherwise changing invalid-tick activations would corrupt mb
+            # M-1's gradients on every stage but the last; mask with f_valid
+            # if the drain data path ever stops recomputing.
+            stash = lax.dynamic_update_index_in_dim(
+                stash, x_in, jnp.clip(m_f, 0, num_micro - 1) % stash_depth, 0
+            )
+            # Last stage: loss + cotangent seed for the same microbatch.
+            loss_u, dy_seed = jax.value_and_grad(loss_fn)(y)
+            is_last = stage == num_stages - 1
+            loss_acc = loss_acc + jnp.where(is_last & f_valid, loss_u, 0.0)
+
+            # ---- backward sub-tick: mb m_b = u - 2(S-1) + stage ------------
+            m_b = u - 2 * (num_stages - 1) + stage
+            b_valid = (m_b >= 0) & (m_b < num_micro)
+            received_cot = ring_shift_left(cot, axis_name)
+            dy = jnp.where(is_last, dy_seed, received_cot)
+            x_saved = lax.dynamic_index_in_dim(
+                stash, jnp.clip(m_b, 0, num_micro - 1) % stash_depth, 0,
+                keepdims=False,
+            )
+            _, f_vjp = jax.vjp(stage_fn, params, x_saved)
+            gparams, dx = f_vjp(dy)
+            gacc = jax.tree.map(
+                lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)),
+                gacc, gparams,
+            )
+            cot_next = jnp.where(b_valid, dx, jnp.zeros_like(dx))
+            return (act_next, cot_next, stash, gacc, loss_acc), None
+
+        (_, _, _, gacc, loss_acc), _ = lax.scan(
+            tick, (act0, cot0, stash0, gacc0, loss0), jnp.arange(total_ticks)
+        )
+        # Mean over microbatches; the loss lives on the last stage only — the
+        # masked psum replicates it (same pattern as gpipe's output collect).
+        loss = (
+            lax.psum(
+                jnp.where(stage == num_stages - 1, loss_acc, 0.0), axis_name
+            )
+            / num_micro
+        )
+        grads = jax.tree.map(lambda g: jnp.expand_dims(g / num_micro, 0), gacc)
+        return loss, grads
+
+    return jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=(P(), P(axis_name)),
         axis_names={axis_name},
     )(stage_params, microbatches)
