@@ -236,6 +236,10 @@ def cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.native_decode and not (args.data_dir or args.data_shards):
+        print("--native-decode without --data-dir/--data-shards would be a "
+              "silent no-op (synthetic data is not decoded)", file=sys.stderr)
+        return 2
     if args.data_dir or args.data_shards:
         from distributed_sigmoid_loss_tpu.data import (
             ImageTextFolder,
@@ -243,8 +247,21 @@ def cmd_train(args) -> int:
         )
 
         tokenize = _byte_tokenize_for(cfg)
+        native_decode = False
+        if args.native_decode:
+            from distributed_sigmoid_loss_tpu.data.native_decode import (
+                native_decode_available,
+            )
+
+            native_decode = native_decode_available()
+            if not native_decode:
+                print("--native-decode: libjpeg engine unavailable, "
+                      "falling back to PIL decode", file=sys.stderr)
         if args.data_dir:
-            source = ImageTextFolder(args.data_dir, cfg, args.batch, tokenize)
+            source = ImageTextFolder(
+                args.data_dir, cfg, args.batch, tokenize,
+                native_decode=native_decode,
+            )
         else:
             import glob as globmod
 
@@ -253,7 +270,9 @@ def cmd_train(args) -> int:
                 print(f"--data-shards matched nothing: {args.data_shards!r}",
                       file=sys.stderr)
                 return 2
-            source = ImageTextShards(shards, cfg, args.batch, tokenize)
+            source = ImageTextShards(
+                shards, cfg, args.batch, tokenize, native_decode=native_decode
+            )
     elif args.native_data:
         from distributed_sigmoid_loss_tpu.data import (
             NativeSyntheticImageText,
@@ -640,6 +659,10 @@ def main(argv=None) -> int:
     tr.add_argument("--data-shards", default="",
                     help="train on webdataset-style tar shards matching this "
                          "glob (real data; single-process)")
+    tr.add_argument("--native-decode", action="store_true",
+                    help="decode real-data images with the native libjpeg "
+                         "engine (threaded, off-GIL; with --data-dir or "
+                         "--data-shards); falls back to PIL with a notice")
     tr.add_argument("--native-data", action="store_true",
                     help="use the C++ input-pipeline engine (native/dataloader.cc) "
                          "instead of the numpy pipeline; falls back with a notice "

@@ -70,22 +70,38 @@ def _pair_key(name: str) -> tuple[str, str] | None:
 
 
 class _PairBatcher:
-    """Accumulate (image_bytes, caption) pairs into static-shape batches."""
+    """Accumulate (image_bytes, caption) pairs into static-shape batches.
 
-    def __init__(self, cfg, batch_size: int, tokenize: Callable):
+    Decode happens at flush time, a full batch at once: with
+    ``native_decode=True`` the libjpeg engine (``data/native_decode.py``) fans
+    the batch over threads off the GIL; otherwise each image goes through the
+    PIL path. Per-image decode-on-add would serialize the native path away.
+    """
+
+    def __init__(
+        self, cfg, batch_size: int, tokenize: Callable, native_decode: bool = False
+    ):
         self.cfg = cfg
         self.batch_size = batch_size
         self.tokenize = tokenize
-        self._images: list[np.ndarray] = []
+        self.native_decode = native_decode
+        self._blobs: list[bytes] = []
         self._texts: list[str] = []
 
     def add(self, image_bytes: bytes, caption: str) -> dict | None:
-        self._images.append(
-            decode_and_resize(image_bytes, self.cfg.vision.image_size)
-        )
+        self._blobs.append(image_bytes)
         self._texts.append(caption)
-        if len(self._images) < self.batch_size:
+        if len(self._blobs) < self.batch_size:
             return None
+        size = self.cfg.vision.image_size
+        if self.native_decode:
+            from distributed_sigmoid_loss_tpu.data.native_decode import decode_batch
+
+            images = decode_batch(self._blobs, size)
+        else:
+            images = np.stack(
+                [decode_and_resize(b, size) for b in self._blobs]
+            )
         tokens = np.asarray(
             self.tokenize(self._texts, self.cfg.text.context_length), np.int32
         )
@@ -98,8 +114,8 @@ class _PairBatcher:
                 f"tokenizer produced ids in [{tokens.min()}, {tokens.max()}] "
                 f"outside vocab_size {self.cfg.text.vocab_size}"
             )
-        batch = {"images": np.stack(self._images), "tokens": tokens}
-        self._images, self._texts = [], []
+        batch = {"images": images, "tokens": tokens}
+        self._blobs, self._texts = [], []
         return batch
 
 
@@ -119,12 +135,14 @@ class ImageTextFolder:
         batch_size: int,
         tokenize: Callable,
         seed: int | None = 0,
+        native_decode: bool = False,
     ):
         self.root = root
         self.cfg = cfg
         self.batch_size = batch_size
         self.tokenize = tokenize
         self.seed = seed
+        self.native_decode = native_decode
         pairs: dict[str, dict] = {}
         for name in sorted(os.listdir(root)):
             key = _pair_key(name)
@@ -150,7 +168,9 @@ class ImageTextFolder:
             order = np.arange(len(self.items))
             if rng is not None:
                 rng.shuffle(order)
-            batcher = _PairBatcher(self.cfg, self.batch_size, self.tokenize)
+            batcher = _PairBatcher(
+                self.cfg, self.batch_size, self.tokenize, self.native_decode
+            )
             for i in order:
                 item = self.items[i]
                 with open(item["image"], "rb") as f:
@@ -181,6 +201,7 @@ class ImageTextShards:
         seed: int | None = 0,
         shard_index: int = 0,
         num_shards: int = 1,
+        native_decode: bool = False,
     ):
         if not shards:
             raise ValueError("no shards given")
@@ -196,6 +217,7 @@ class ImageTextShards:
         self.batch_size = batch_size
         self.tokenize = tokenize
         self.seed = seed
+        self.native_decode = native_decode
 
     def __iter__(self) -> Iterator[dict]:
         rng = np.random.default_rng(self.seed) if self.seed is not None else None
@@ -204,7 +226,9 @@ class ImageTextShards:
             order = np.arange(len(self.shards))
             if rng is not None:
                 rng.shuffle(order)
-            batcher = _PairBatcher(self.cfg, self.batch_size, self.tokenize)
+            batcher = _PairBatcher(
+                self.cfg, self.batch_size, self.tokenize, self.native_decode
+            )
             for si in order:
                 with tarfile.open(self.shards[si], "r") as tf:
                     pending: dict[str, dict] = {}

@@ -1,0 +1,110 @@
+"""ctypes binding for the native JPEG decode path (``native/jpeg_decode.cc``).
+
+Image decode is the host-side cost of real-data training — the work torch's
+DataLoader workers / tf.data's C++ ops do natively in the reference ecosystem.
+:func:`decode_batch` decodes a list of image blobs to the training layout
+((S, S, 3) float32 in [-1, 1], shorter-side resize + center crop — the same
+geometry as ``files.decode_and_resize``) with libjpeg fanned over threads, off
+the GIL. Non-JPEG formats and corrupt blobs fall back to the PIL path
+per-image, so the function accepts anything ``decode_and_resize`` does.
+
+Gated separately from the synthetic engine's ``libdsl_data.so``: this library
+links ``-ljpeg``, and :func:`native_decode_available` is False wherever
+libjpeg (or a compiler) is missing — callers then use pure PIL.
+
+Numerics note: libjpeg's IDCT and the fused bilinear differ from PIL's
+(antialiased) resampling by a few least-significant bits per pixel — fine for
+training pixels, not for bitwise-reproducing a PIL-decoded eval set. The
+deterministic contract is per-library, not cross-library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import warnings
+
+import numpy as np
+
+from distributed_sigmoid_loss_tpu.data.native_loader import build_shared_lib
+
+__all__ = ["native_decode_available", "decode_batch"]
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SRC = os.path.join(_NATIVE_DIR, "jpeg_decode.cc")
+_LIB = os.path.join(_NATIVE_DIR, "libdsl_jpeg.so")
+
+_build_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _load():
+    global _lib, _lib_failed
+    with _build_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            # Shared artifact rules with the synthetic engine: prebuilt-.so
+            # deployments and stale-lib/compiler-less hosts keep working.
+            lib = ctypes.CDLL(build_shared_lib(_SRC, _LIB, ldflags=("-ljpeg",)))
+            lib.dsl_jpeg_decode_batch.restype = ctypes.c_int64
+            lib.dsl_jpeg_decode_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            _lib = lib
+        except Exception as e:
+            _lib_failed = True
+            warnings.warn(f"native JPEG decode unavailable ({e}); using PIL")
+        return _lib
+
+
+def native_decode_available() -> bool:
+    return _load() is not None
+
+
+def decode_batch(
+    blobs: list[bytes], image_size: int, threads: int | None = None
+) -> np.ndarray:
+    """Decode image blobs → ``(len(blobs), S, S, 3)`` float32 in [-1, 1].
+
+    JPEGs go through the native threaded path; anything it rejects (other
+    formats, corrupt data) is retried with ``files.decode_and_resize`` (PIL),
+    which raises on genuinely undecodable input — same failure surface as the
+    pure-PIL loaders.
+    """
+    from distributed_sigmoid_loss_tpu.data.files import decode_and_resize
+
+    n = len(blobs)
+    out = np.zeros((n, image_size, image_size, 3), np.float32)
+    lib = _load()
+    todo = range(n)
+    if lib is not None and n:
+        datas = (ctypes.c_char_p * n)(*blobs)
+        lens = (ctypes.c_int64 * n)(*[len(b) for b in blobs])
+        fail = (ctypes.c_uint8 * n)()
+        if threads is None:
+            threads = min(n, os.cpu_count() or 1)
+        lib.dsl_jpeg_decode_batch(
+            ctypes.cast(datas, ctypes.POINTER(ctypes.c_char_p)),
+            lens,
+            n,
+            image_size,
+            max(1, threads),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            fail,
+        )
+        todo = [i for i in range(n) if fail[i]]
+    for i in todo:
+        out[i] = decode_and_resize(blobs[i], image_size)
+    return out

@@ -29,7 +29,12 @@ import numpy as np
 
 from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
 
-__all__ = ["native_available", "NativeSyntheticImageText", "load_library"]
+__all__ = [
+    "build_shared_lib",
+    "native_available",
+    "NativeSyntheticImageText",
+    "load_library",
+]
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -46,26 +51,29 @@ _lib = None
 _DEFAULT_CXXFLAGS = "-O3 -std=c++17 -fPIC -Wall -Wextra -pthread"
 
 
-def _build() -> str:
-    """Compile the shared library when missing or older than its source.
+def build_shared_lib(src: str, lib: str, ldflags: tuple[str, ...] = ()) -> str:
+    """Compile ``src`` into shared library ``lib`` when missing or older than
+    its source; returns the library path. Shared by every native component
+    (dataloader, jpeg decode) so the artifact rules stay identical:
 
-    A prebuilt ``.so`` without the source (deployment artifact) is used as-is;
-    a stale ``.so`` on a machine without a compiler is used with a warning
-    rather than failing a working setup.
+    - A prebuilt ``.so`` without the source (deployment artifact) is used
+      as-is.
+    - A stale ``.so`` on a machine without a compiler is used with a warning
+      rather than failing a working setup.
     """
-    have_lib = os.path.exists(_LIB)
-    if not os.path.exists(_SRC):
+    have_lib = os.path.exists(lib)
+    if not os.path.exists(src):
         if have_lib:
-            return _LIB
+            return lib
         raise RuntimeError(
-            f"native dataloader: neither {_LIB} nor its source {_SRC} exists"
+            f"native build: neither {lib} nor its source {src} exists"
         )
-    if have_lib and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-        return _LIB
+    if have_lib and os.path.getmtime(lib) >= os.path.getmtime(src):
+        return lib
     cmd = [
         os.environ.get("CXX", "g++"),
         *os.environ.get("CXXFLAGS", _DEFAULT_CXXFLAGS).split(),
-        "-shared", "-o", _LIB, _SRC,
+        "-shared", "-o", lib, src, *ldflags,
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -79,16 +87,20 @@ def _build() -> str:
             import warnings
 
             warnings.warn(
-                f"native dataloader: rebuild for newer {_SRC} failed "
-                f"({failure}); using the existing (stale) {_LIB}",
+                f"native build: rebuild for newer {src} failed "
+                f"({failure}); using the existing (stale) {lib}",
                 RuntimeWarning,
                 stacklevel=2,
             )
-            return _LIB
+            return lib
         raise RuntimeError(
-            f"native dataloader build failed ({' '.join(cmd)}): {failure}"
+            f"native build failed ({' '.join(cmd)}): {failure}"
         )
-    return _LIB
+    return lib
+
+
+def _build() -> str:
+    return build_shared_lib(_SRC, _LIB)
 
 
 def load_library():

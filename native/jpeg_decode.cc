@@ -1,0 +1,223 @@
+// Native JPEG decode + resize for the input pipeline (C++17, libjpeg).
+//
+// Role in the framework: real-data training is bottlenecked by host-side image
+// decode — the work torch's native DataLoader workers and tf.data's C++ ops do
+// off the interpreter. This file is that path for the webdataset/folder loaders
+// (data/files.py): decode JPEG bytes, shorter-side bilinear resize + center
+// crop (the open_clip/SigLIP eval geometry, matching decode_and_resize), scale
+// to [-1, 1] float32 NHWC — fanned over threads, no GIL anywhere.
+//
+// Kept separate from libdsl_data.so so the synthetic engine never depends on
+// libjpeg's presence; data/native_decode.py gates on this library and falls
+// back to PIL per-image.
+//
+// Decode fast path: libjpeg's DCT scaling decodes at 1/2, 1/4, 1/8 resolution
+// directly from the coefficients; we pick the largest denominator that keeps
+// the shorter side >= the target, cutting IDCT + resize work ~denom^2 for
+// large photos.
+
+#include <cstddef>  // jpeglib.h uses size_t/FILE without including their
+#include <cstdio>   // headers itself — both must precede it.
+#include <jpeglib.h>
+#include <setjmp.h>
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void on_error(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<ErrorMgr*>(cinfo->err)->jump, 1);
+}
+void swallow_message(j_common_ptr) {}
+
+// Decode one JPEG into an RGB buffer (possibly DCT-downscaled); returns false
+// on any libjpeg error. rgb is resized to w*h*3.
+bool decode_rgb(const uint8_t* data, size_t len, int target_short,
+                std::vector<uint8_t>& rgb, int& w, int& h) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = on_error;
+  err.pub.output_message = swallow_message;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, (unsigned long)len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  // Largest 1/2^k DCT downscale that keeps the shorter side >= target (the
+  // bilinear pass below does the final fractional step).
+  const int short_side = (int)std::min(cinfo.image_width, cinfo.image_height);
+  int denom = 1;
+  while (denom < 8 && short_side / (denom * 2) >= target_short) denom *= 2;
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = (unsigned)denom;
+  jpeg_start_decompress(&cinfo);
+  w = (int)cinfo.output_width;
+  h = (int)cinfo.output_height;
+  if (w <= 0 || h <= 0 || cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  rgb.resize((size_t)w * h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = rgb.data() + (size_t)cinfo.output_scanline * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Triangle-filter (antialiased bilinear) resampling coefficients for one
+// axis, PIL-style: on downscale the filter support widens to the scale
+// factor, so every source pixel contributes — plain point-bilinear aliases
+// high-frequency content and lands visibly far from PIL's output.
+struct AxisTaps {
+  std::vector<int> first;      // per output pixel: first source index
+  std::vector<int> count;      // taps per output pixel
+  std::vector<double> weight;  // flattened [out][tap] weights, normalized
+  int max_taps = 0;
+};
+
+AxisTaps make_taps(int src, int dst_full, int out_lo, int out_n) {
+  AxisTaps t;
+  const double scale = (double)src / dst_full;
+  const double filterscale = std::max(scale, 1.0);
+  const double support = 1.0 * filterscale;  // triangle filter radius
+  t.first.resize(out_n);
+  t.count.resize(out_n);
+  t.max_taps = (int)std::ceil(support * 2) + 2;
+  t.weight.assign((size_t)out_n * t.max_taps, 0.0);
+  for (int o = 0; o < out_n; ++o) {
+    const double center = (out_lo + o + 0.5) * scale;
+    int xmin = (int)(center - support + 0.5);
+    int xmax = (int)(center + support + 0.5);
+    xmin = std::max(xmin, 0);
+    xmax = std::min(xmax, src);
+    double total = 0.0;
+    const int k0 = xmin;
+    for (int k = xmin; k < xmax; ++k) {
+      const double x = (k + 0.5 - center) / filterscale;
+      const double wgt = x > -1.0 && x < 1.0 ? 1.0 - std::abs(x) : 0.0;
+      t.weight[(size_t)o * t.max_taps + (k - k0)] = wgt;
+      total += wgt;
+    }
+    t.first[o] = k0;
+    t.count[o] = xmax - k0;
+    if (total > 0)
+      for (int k = 0; k < t.count[o]; ++k)
+        t.weight[(size_t)o * t.max_taps + k] /= total;
+  }
+  return t;
+}
+
+// Shorter-side resize to >= S then SxS center crop, fused: only the cropped
+// rows/columns are ever computed. Geometry matches decode_and_resize
+// (files.py): scale = S/min(w,h), resized dims rounded, crop offsets
+// floor((n-S)/2); resampling is the separable triangle filter (PIL BILINEAR).
+void resize_crop(const std::vector<uint8_t>& rgb, int w, int h, int S,
+                 float* out) {
+  const double scale = (double)S / std::min(w, h);
+  const int nw = std::max(S, (int)std::lround(w * scale));
+  const int nh = std::max(S, (int)std::lround(h * scale));
+  const int left = (nw - S) / 2, top = (nh - S) / 2;
+  const AxisTaps tx = make_taps(w, nw, left, S);
+  const AxisTaps ty = make_taps(h, nh, top, S);
+
+  // Horizontal pass over only the source rows the vertical taps touch.
+  int row_lo = h, row_hi = 0;
+  for (int i = 0; i < S; ++i) {
+    row_lo = std::min(row_lo, ty.first[i]);
+    row_hi = std::max(row_hi, ty.first[i] + ty.count[i]);
+  }
+  std::vector<float> tmp((size_t)(row_hi - row_lo) * S * 3);
+  for (int y = row_lo; y < row_hi; ++y) {
+    const uint8_t* src_row = &rgb[(size_t)y * w * 3];
+    float* dst_row = &tmp[(size_t)(y - row_lo) * S * 3];
+    for (int j = 0; j < S; ++j) {
+      const int k0 = tx.first[j], kn = tx.count[j];
+      const double* wgt = &tx.weight[(size_t)j * tx.max_taps];
+      double r = 0, g = 0, b = 0;
+      for (int k = 0; k < kn; ++k) {
+        const uint8_t* p = src_row + (size_t)(k0 + k) * 3;
+        r += wgt[k] * p[0];
+        g += wgt[k] * p[1];
+        b += wgt[k] * p[2];
+      }
+      dst_row[j * 3] = (float)r;
+      dst_row[j * 3 + 1] = (float)g;
+      dst_row[j * 3 + 2] = (float)b;
+    }
+  }
+  // Vertical pass + [-1, 1] scaling (like decode_and_resize).
+  for (int i = 0; i < S; ++i) {
+    const int k0 = ty.first[i], kn = ty.count[i];
+    const double* wgt = &ty.weight[(size_t)i * ty.max_taps];
+    float* o_row = out + (size_t)i * S * 3;
+    for (int j = 0; j < S * 3; ++j) {
+      double v = 0;
+      for (int k = 0; k < kn; ++k)
+        v += wgt[k] * tmp[(size_t)(k0 + k - row_lo) * S * 3 + j];
+      o_row[j] = (float)(v / 127.5 - 1.0);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode n JPEG blobs into out (n, S, S, 3) float32 [-1,1], fanning the work
+// over `threads` std::threads. Failed decodes (corrupt bytes, non-JPEG, CMYK,
+// ...) zero-fill their slot and set fail_mask[i]=1 so the caller can re-decode
+// those through its fallback. Returns the number of failures.
+int64_t dsl_jpeg_decode_batch(const uint8_t* const* datas, const int64_t* lens,
+                              int64_t n, int64_t image_size, int threads,
+                              float* out, uint8_t* fail_mask) {
+  if (n <= 0 || image_size <= 0 || threads <= 0) return n > 0 ? n : 0;
+  const size_t per = (size_t)image_size * image_size * 3;
+  std::vector<int64_t> fails_per_thread((size_t)threads, 0);
+  auto run = [&](int t) {
+    std::vector<uint8_t> rgb;  // reused across this thread's images
+    for (int64_t i = t; i < n; i += threads) {
+      int w = 0, h = 0;
+      float* dst = out + (size_t)i * per;
+      if (decode_rgb(datas[i], (size_t)lens[i], (int)image_size, rgb, w, h)) {
+        resize_crop(rgb, w, h, (int)image_size, dst);
+        fail_mask[i] = 0;
+      } else {
+        std::memset(dst, 0, per * sizeof(float));
+        fail_mask[i] = 1;
+        ++fails_per_thread[(size_t)t];
+      }
+    }
+  };
+  if (threads == 1) {
+    run(0);
+  } else {
+    std::vector<std::thread> pool;
+    for (int t = 0; t < threads; ++t) pool.emplace_back(run, t);
+    for (auto& t : pool) t.join();
+  }
+  int64_t total = 0;
+  for (int64_t f : fails_per_thread) total += f;
+  return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,112 @@
+"""Native JPEG decode engine (native/jpeg_decode.cc) — parity and fallback.
+
+The decode contract is files.decode_and_resize's: shorter-side resize +
+center crop to (S, S, 3) float32 in [-1, 1]. The native path (libjpeg +
+separable triangle filter) is numerically close to PIL, not bitwise — the
+parity tolerance here pins how close. Non-JPEG and corrupt inputs must fall
+back to / fail like the PIL path.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.data.files import decode_and_resize
+from distributed_sigmoid_loss_tpu.data.native_decode import (
+    decode_batch,
+    native_decode_available,
+)
+
+PIL = pytest.importorskip("PIL.Image")
+
+
+def _jpeg(w, h, seed=0, quality=95):
+    rng = np.random.default_rng(seed)
+    arr = (rng.random((h, w, 3)) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    PIL.fromarray(arr).save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _png(w, h, seed=0):
+    rng = np.random.default_rng(seed)
+    arr = (rng.random((h, w, 3)) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    PIL.fromarray(arr).save(buf, "PNG")
+    return buf.getvalue()
+
+
+needs_native = pytest.mark.skipif(
+    not native_decode_available(), reason="libjpeg engine unavailable"
+)
+
+
+@needs_native
+@pytest.mark.parametrize("w,h", [(320, 240), (100, 300), (64, 64), (640, 480)])
+def test_native_decode_close_to_pil(w, h):
+    """Landscape, portrait, exact-size, and DCT-prescaled geometries all land
+    within tolerance of the PIL path on worst-case (noise) content."""
+    blob = _jpeg(w, h)
+    got = decode_batch([blob], 64)[0]
+    want = decode_and_resize(blob, 64)
+    assert got.shape == want.shape == (64, 64, 3)
+    assert np.abs(got - want).mean() < 0.05
+    assert got.min() >= -1.0 and got.max() <= 1.0
+
+
+@needs_native
+def test_non_jpeg_falls_back_to_pil_bitwise():
+    """PNG is rejected by libjpeg and must come back BITWISE equal to the PIL
+    path (it IS the PIL path via the per-image fallback)."""
+    blob = _png(120, 90)
+    got = decode_batch([blob], 48)[0]
+    want = decode_and_resize(blob, 48)
+    np.testing.assert_array_equal(got, want)
+
+
+@needs_native
+def test_mixed_batch_and_determinism():
+    blobs = [_jpeg(200, 150, seed=i) for i in range(3)] + [_png(80, 80)]
+    a = decode_batch(blobs, 32, threads=4)
+    b = decode_batch(blobs, 32, threads=1)
+    assert a.shape == (4, 32, 32, 3)
+    # Thread count must not change the stream (each slot is an independent
+    # pure function of its blob).
+    np.testing.assert_array_equal(a, b)
+
+
+@needs_native
+def test_corrupt_blob_raises_like_pil():
+    with pytest.raises(Exception):
+        decode_batch([b"not an image at all"], 32)
+
+
+def test_loader_native_decode_matches_pil_loader(tmp_path):
+    """ImageTextFolder(native_decode=True) yields the same tokens and
+    near-identical images as the PIL loader on the same directory."""
+    from distributed_sigmoid_loss_tpu.data import ByteTokenizer, ImageTextFolder
+    from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+    cfg = SigLIPConfig.tiny_test()
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        arr = (rng.random((96, 128, 3)) * 255).astype(np.uint8)
+        PIL.fromarray(arr).save(tmp_path / f"im{i}.jpg", quality=95)
+        (tmp_path / f"im{i}.txt").write_text(f"caption {i}")
+
+    tok = ByteTokenizer()
+
+    def tokenize(texts, length):
+        return np.asarray(tok(texts, length)) % cfg.text.vocab_size
+
+    kw = dict(cfg=cfg, batch_size=4, tokenize=tokenize, seed=0)
+    pil_batch = next(iter(ImageTextFolder(str(tmp_path), **kw)))
+    nat_batch = next(
+        iter(ImageTextFolder(str(tmp_path), native_decode=True, **kw))
+    )
+    np.testing.assert_array_equal(pil_batch["tokens"], nat_batch["tokens"])
+    if native_decode_available():
+        assert np.abs(pil_batch["images"] - nat_batch["images"]).mean() < 0.05
+    else:
+        np.testing.assert_array_equal(pil_batch["images"], nat_batch["images"])
