@@ -427,8 +427,11 @@ def cmd_eval(args) -> int:
         from distributed_sigmoid_loss_tpu.utils.config import TrainConfig
 
         tx = make_optimizer(TrainConfig())
+        # zeros=True: the state is only a restore TARGET (structure + shapes +
+        # shardings); running the real random init here costs minutes of host
+        # RNG on b16-class towers before the checkpoint overwrites every leaf.
         state = create_train_state(
-            jax.random.key(0), model, tx, batch, mesh, ema=args.ema
+            jax.random.key(0), model, tx, batch, mesh, ema=args.ema, zeros=True
         )
         try:
             restored = restore_latest(args.ckpt_dir, state)
@@ -439,7 +442,8 @@ def cmd_eval(args) -> int:
             # ORIGINAL error rather than guessing from message text.
             try:
                 alt = create_train_state(
-                    jax.random.key(0), model, tx, batch, mesh, ema=not args.ema
+                    jax.random.key(0), model, tx, batch, mesh,
+                    ema=not args.ema, zeros=True,
                 )
                 restored = restore_latest(args.ckpt_dir, alt)
             except Exception:

@@ -155,11 +155,18 @@ def zero1_constrain(opt_state: Any, mesh: Mesh, axis_name: str = "dp") -> Any:
 
 
 def init_params(
-    rng: jax.Array, model: nn.Module, sample_batch: dict, mesh: Mesh
+    rng: jax.Array, model: nn.Module, sample_batch: dict, mesh: Mesh,
+    zeros: bool = False,
 ) -> Any:
     """Initialize model params directly sharded onto the mesh (no host
     round-trip) — the forward-only half of :func:`create_train_state`, for eval
-    paths that never need optimizer slots."""
+    paths that never need optimizer slots.
+
+    ``zeros=True`` skips the random initializers and fills every leaf with
+    zeros — same shapes/dtypes/shardings at a memset's cost. For checkpoint
+    *restore targets* (eval, resume) the values are immediately overwritten,
+    and running the real init there costs minutes of host RNG on large towers.
+    """
 
     def init_fn(rng):
         variables = model.init(rng, sample_batch["images"], sample_batch["tokens"])
@@ -169,6 +176,14 @@ def init_params(
     shardings = param_shardings(mesh, abstract)
     # Unbox the Partitioned metadata: shardings now carry the placement info.
     unboxed_shardings = nn.meta.unbox(shardings)
+    if zeros:
+        abstract_unboxed = nn.meta.unbox(abstract)
+        return jax.jit(
+            lambda: jax.tree.map(
+                lambda a: jnp.zeros(a.shape, a.dtype), abstract_unboxed
+            ),
+            out_shardings=unboxed_shardings,
+        )()
     return jax.jit(
         lambda r: nn.meta.unbox(init_fn(r)), out_shardings=unboxed_shardings
     )(rng)
@@ -183,15 +198,17 @@ def create_train_state(
     zero1: bool = False,
     axis_name: str = "dp",
     ema: bool = False,
+    zeros: bool = False,
 ) -> TrainState:
     """Initialize a full train state, every leaf committed to the mesh.
 
     ``zero1=True`` shards the optimizer state over ``axis_name`` (ZeRO-1); pass
     the same flag to :func:`make_train_step` so the step keeps it sharded.
     ``ema=True`` adds an EMA copy of the params (pair with ``ema_decay`` on
-    :func:`make_train_step`).
+    :func:`make_train_step`). ``zeros=True`` builds a zero-filled state (same
+    structure/shardings, no random init) — for checkpoint restore targets.
     """
-    params = init_params(rng, model, sample_batch, mesh)
+    params = init_params(rng, model, sample_batch, mesh, zeros=zeros)
 
     # Build the optimizer state under jit too, so every leaf (adam moments follow the
     # param shardings — or their ZeRO-1 placement — and scalar counters replicate) is

@@ -134,8 +134,11 @@ AxisTaps make_taps(int src, int dst_full, int out_lo, int out_n) {
 void resize_crop(const std::vector<uint8_t>& rgb, int w, int h, int S,
                  float* out) {
   const double scale = (double)S / std::min(w, h);
-  const int nw = std::max(S, (int)std::lround(w * scale));
-  const int nh = std::max(S, (int)std::lround(h * scale));
+  // nearbyint = round-half-to-even (FE_TONEAREST), matching Python's round()
+  // in decode_and_resize — lround's half-away-from-zero would shift the
+  // geometry by a pixel whenever w*scale lands exactly on .5.
+  const int nw = std::max(S, (int)std::nearbyint(w * scale));
+  const int nh = std::max(S, (int)std::nearbyint(h * scale));
   const int left = (nw - S) / 2, top = (nh - S) / 2;
   const AxisTaps tx = make_taps(w, nw, left, S);
   const AxisTaps ty = make_taps(h, nh, top, S);

@@ -241,3 +241,35 @@ def test_bf16_adam_moments_track_f32_and_halve_dtype():
         jax.tree.leaves(jax.device_get(s16.params)),
     ):
         np.testing.assert_allclose(a, b, rtol=0.05, atol=2e-4)
+
+
+def test_zeros_train_state_matches_real_structure():
+    """`create_train_state(zeros=True)` (checkpoint restore targets) must have
+    identical treedef/shapes/dtypes/shardings to the real init — only values
+    differ."""
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    mesh = make_2d_mesh(4, 2)
+    batch = tiny_batch(8, cfg)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+
+    real = create_train_state(jax.random.key(0), model, tx, batch, mesh, ema=True)
+    zero = create_train_state(
+        jax.random.key(0), model, tx, batch, mesh, ema=True, zeros=True
+    )
+
+    assert jax.tree.structure(real) == jax.tree.structure(zero)
+    for a, b in zip(jax.tree.leaves(real), jax.tree.leaves(zero)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+        assert a.sharding.is_equivalent_to(b.sharding, len(a.shape))
+    # And it works as a restore target.
+    import tempfile
+
+    pytest.importorskip("orbax.checkpoint")
+    from distributed_sigmoid_loss_tpu.train import restore_checkpoint, save_checkpoint
+
+    with tempfile.TemporaryDirectory() as d:
+        save_checkpoint(f"{d}/ck", real)
+        restored = restore_checkpoint(f"{d}/ck", zero)
+    for a, b in zip(jax.tree.leaves(real), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
