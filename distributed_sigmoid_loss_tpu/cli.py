@@ -297,9 +297,13 @@ def cmd_train(args) -> int:
     data = iter(source)
     first = next(data)
 
+    # When resuming, the freshly-created state is only train_resilient's
+    # restore target — zeros=True skips the (minutes-long on b16-class towers)
+    # random init that the checkpoint would immediately overwrite.
+    resuming = bool(args.ckpt_dir) and latest_step(args.ckpt_dir) is not None
     state = create_train_state(
         jax.random.key(0), model, tx, first, mesh, zero1=args.zero1,
-        ema=args.ema_decay is not None,
+        ema=args.ema_decay is not None, zeros=resuming,
     )
     step_fn, shardings = make_train_step(
         model,

@@ -189,7 +189,10 @@ class ImageTextShards:
     num_shards`` stripes the shard list across hosts (process i reads shards
     i, i+N, i+2N, ... — the standard multi-host split, zero coordination).
     Members are paired by basename within a shard; pairs stream in tar order
-    (shard-shuffled per epoch by ``seed``), so memory stays O(batch).
+    (shard-shuffled per epoch by ``seed``) with an optional bounded
+    ``shuffle_buffer`` (webdataset's sample-shuffle: a reservoir of that many
+    pairs, emit a random one as each new pair streams in — memory stays
+    O(buffer + batch) and the stream is deterministic given ``seed``).
     """
 
     def __init__(
@@ -202,6 +205,7 @@ class ImageTextShards:
         shard_index: int = 0,
         num_shards: int = 1,
         native_decode: bool = False,
+        shuffle_buffer: int = 0,
     ):
         if not shards:
             raise ValueError("no shards given")
@@ -218,6 +222,52 @@ class ImageTextShards:
         self.tokenize = tokenize
         self.seed = seed
         self.native_decode = native_decode
+        if shuffle_buffer < 0:
+            raise ValueError(f"shuffle_buffer must be >= 0, got {shuffle_buffer}")
+        if shuffle_buffer and seed is None:
+            # The reservoir needs an RNG; a shuffling-but-unseeded stream would
+            # silently be nondeterministic while every other knob is seeded.
+            raise ValueError("shuffle_buffer requires a seed")
+        self.shuffle_buffer = shuffle_buffer
+
+    def _pairs(self, order) -> Iterator[tuple[bytes, str]]:
+        """(image_bytes, caption) pairs across the epoch's shards, tar order."""
+        for si in order:
+            with tarfile.open(self.shards[si], "r") as tf:
+                pending: dict[str, dict] = {}
+                for member in tf:
+                    if not member.isfile():
+                        continue
+                    key = _pair_key(os.path.basename(member.name))
+                    if key is None:
+                        continue
+                    base, kind = key
+                    buf = tf.extractfile(member)
+                    if buf is None:
+                        continue
+                    entry = pending.setdefault(base, {})
+                    entry[kind] = buf.read()
+                    if "image" in entry and "text" in entry:
+                        del pending[base]
+                        yield entry["image"], entry["text"].decode("utf-8").strip()
+
+    def _shuffled(self, pairs, rng) -> Iterator[tuple[bytes, str]]:
+        """Bounded reservoir shuffle (webdataset-style): hold ``shuffle_buffer``
+        pairs, emit a uniformly random held one per incoming pair, drain at
+        epoch end in random order."""
+        held: list = []
+        for pair in pairs:
+            if len(held) < self.shuffle_buffer:
+                held.append(pair)
+                continue
+            i = int(rng.integers(len(held)))
+            held[i], pair = pair, held[i]
+            yield pair
+        while held:
+            i = int(rng.integers(len(held)))
+            held[i], last = held[-1], held[i]
+            held.pop()
+            yield last
 
     def __iter__(self) -> Iterator[dict]:
         rng = np.random.default_rng(self.seed) if self.seed is not None else None
@@ -229,30 +279,14 @@ class ImageTextShards:
             batcher = _PairBatcher(
                 self.cfg, self.batch_size, self.tokenize, self.native_decode
             )
-            for si in order:
-                with tarfile.open(self.shards[si], "r") as tf:
-                    pending: dict[str, dict] = {}
-                    for member in tf:
-                        if not member.isfile():
-                            continue
-                        key = _pair_key(os.path.basename(member.name))
-                        if key is None:
-                            continue
-                        base, kind = key
-                        buf = tf.extractfile(member)
-                        if buf is None:
-                            continue
-                        entry = pending.setdefault(base, {})
-                        entry[kind] = buf.read()
-                        if "image" in entry and "text" in entry:
-                            del pending[base]
-                            batch = batcher.add(
-                                entry["image"],
-                                entry["text"].decode("utf-8").strip(),
-                            )
-                            if batch is not None:
-                                yielded = True
-                                yield batch
+            pairs = self._pairs(order)
+            if self.shuffle_buffer:
+                pairs = self._shuffled(pairs, rng)
+            for image_bytes, caption in pairs:
+                batch = batcher.add(image_bytes, caption)
+                if batch is not None:
+                    yielded = True
+                    yield batch
             if not yielded:
                 # Mirror ImageTextFolder's too-few-pairs ValueError (which can
                 # check up front); here pair counts are only known after a full

@@ -85,7 +85,7 @@ def test_sharded_matches_single_device(world_size, global_b, d, variant):
         )
 
 
-@pytest.mark.parametrize("world_size,global_b,d", [(2, 4, 4), (2, 4, 128), (3, 3, 2), (4, 8, 32), (8, 8, 16)])
+@pytest.mark.parametrize("world_size,global_b,d", [(2, 4, 4), (2, 4, 128), (3, 3, 2), (4, 8, 32), (5, 5, 8), (6, 6, 8), (7, 7, 8), (8, 8, 16)])
 @pytest.mark.parametrize("bidir", [True, False])
 def test_allgather_matches_ring(world_size, global_b, d, bidir):
     """Oracle #2: the two comm variants agree (reference compare_naive_vs_rw).
