@@ -236,6 +236,10 @@ def cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.shuffle_buffer and not args.data_shards:
+        print("--shuffle-buffer applies to --data-shards streams only "
+              "(--data-dir already shuffles whole epochs)", file=sys.stderr)
+        return 2
     if args.native_decode and not (args.data_dir or args.data_shards):
         print("--native-decode without --data-dir/--data-shards would be a "
               "silent no-op (synthetic data is not decoded)", file=sys.stderr)
@@ -271,7 +275,8 @@ def cmd_train(args) -> int:
                       file=sys.stderr)
                 return 2
             source = ImageTextShards(
-                shards, cfg, args.batch, tokenize, native_decode=native_decode
+                shards, cfg, args.batch, tokenize, native_decode=native_decode,
+                shuffle_buffer=args.shuffle_buffer,
             )
     elif args.native_data:
         from distributed_sigmoid_loss_tpu.data import (
@@ -667,6 +672,9 @@ def main(argv=None) -> int:
     tr.add_argument("--data-shards", default="",
                     help="train on webdataset-style tar shards matching this "
                          "glob (real data; single-process)")
+    tr.add_argument("--shuffle-buffer", type=int, default=0,
+                    help="sample-shuffle reservoir size for --data-shards "
+                         "(webdataset-style; 0 = stream in tar order)")
     tr.add_argument("--native-decode", action="store_true",
                     help="decode real-data images with the native libjpeg "
                          "engine (threaded, off-GIL; with --data-dir or "

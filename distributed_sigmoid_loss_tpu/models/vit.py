@@ -47,7 +47,10 @@ class ViT(nn.Module):
         x = Encoder(
             cfg.width, cfg.depth, cfg.num_heads, cfg.mlp_ratio, dtype,
             remat=cfg.remat, scan_layers=cfg.scan_layers, attn_impl=cfg.attn_impl,
-            remat_policy=cfg.remat_policy, moe_experts=cfg.moe_experts,
+            remat_policy=cfg.remat_policy,
+            sp_axis=cfg.sequence_parallel_axis,
+            sp_impl=cfg.sequence_parallel_impl,
+            moe_experts=cfg.moe_experts,
             moe_num_selected=cfg.moe_num_selected,
             moe_capacity_factor=cfg.moe_capacity_factor,
             moe_group_size=cfg.moe_group_size, name="encoder",

@@ -51,6 +51,13 @@ class ViTConfig:
     # "nothing" = full remat; "save_hot" = save attention-core + MLP-hidden
     # activations across backward (recompute only projections/elementwise).
     remat_policy: Literal["nothing", "save_hot", "save_all_hot", "save_mlp"] = "nothing"
+    # Long-context vision (high-res ViTs: 384px/14 = 729 tokens, 512px/16 =
+    # 1024): shard the patch sequence over this mesh axis and run
+    # sequence-parallel attention in the blocks — same contract as the text
+    # tower's fields (the MAP pooling head stays sequence-global; GSPMD
+    # gathers for it). The axis size must divide the patch count.
+    sequence_parallel_axis: str | None = None
+    sequence_parallel_impl: Literal["ring", "ulysses"] = "ring"
     # Mixture-of-experts: >0 swaps each block's dense MLP for that many experts
     # (expert weights shard over the "ep" mesh axis; see models/moe.py). Train
     # with moe_aux_weight on make_train_step so routing stays balanced.
