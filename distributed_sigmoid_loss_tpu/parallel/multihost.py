@@ -128,12 +128,21 @@ def make_hybrid_mesh(
     raises. The returned mesh's dp axis has size ``dp_dcn * dp_ici``; collectives
     over tp never leave a slice.
     """
-    n_dev = len(jax.devices())
+    devices = _hybrid_device_array(dp_dcn, dp_ici, tp_ici, jax.devices())
+    return Mesh(devices, axis_names)
+
+
+def _hybrid_device_array(dp_dcn, dp_ici, tp_ici, devices) -> np.ndarray:
+    """The (dp_dcn*dp_ici, tp_ici) device arrangement behind
+    :func:`make_hybrid_mesh` — split out so the multi-slice (``dp_dcn > 1``)
+    branch is testable with fake multi-slice device objects (real multi-slice
+    metadata never exists in the CI environment)."""
+    n_dev = len(devices)
     if dp_dcn is None:
         # The DCN factor is the real slice count, NOT the leftover device factor:
         # on a single slice (or CPU emulation, where devices carry no slice_index)
         # the leftover belongs to dp_ici.
-        slice_ids = {getattr(d, "slice_index", 0) for d in jax.devices()}
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
         dp_dcn = len(slice_ids)
     if dp_ici is None:
         if n_dev % (dp_dcn * tp_ici) != 0:
@@ -147,14 +156,16 @@ def make_hybrid_mesh(
             f"dp_dcn*dp_ici*tp_ici = {dp_dcn * dp_ici * tp_ici} != device count {n_dev}"
         )
     if dp_dcn > 1:
-        devices = mesh_utils.create_hybrid_device_mesh(
+        arr = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(dp_ici, tp_ici),
             dcn_mesh_shape=(dp_dcn, 1),
+            devices=devices,
         )
     else:
-        devices = mesh_utils.create_device_mesh((dp_dcn * dp_ici, tp_ici))
-    devices = np.asarray(devices).reshape(dp_dcn * dp_ici, tp_ici)
-    return Mesh(devices, axis_names)
+        arr = mesh_utils.create_device_mesh(
+            (dp_dcn * dp_ici, tp_ici), devices=devices
+        )
+    return np.asarray(arr).reshape(dp_dcn * dp_ici, tp_ici)
 
 
 def global_batch_for(per_chip_batch: int, mesh: Mesh, axis_name: str = data_axis) -> int:

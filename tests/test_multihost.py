@@ -4,6 +4,7 @@ import jax
 import pytest
 
 from distributed_sigmoid_loss_tpu.parallel.multihost import (
+    _hybrid_device_array,
     global_batch_for,
     initialize_multihost,
     make_hybrid_mesh,
@@ -54,3 +55,46 @@ def test_hybrid_mesh_runs_sharded_loss():
     rng = np.random.default_rng(0)
     z = l2_normalize(jnp.asarray(rng.standard_normal((8, 32)), jnp.float32))
     assert np.isfinite(float(fn(init_loss_params(), z, z)))
+
+
+class _FakeSliceDevice:
+    """Minimal device stand-in carrying the attributes
+    mesh_utils.create_hybrid_device_mesh actually reads — real multi-slice
+    metadata cannot exist in this environment."""
+
+    def __init__(self, id, slice_index):
+        self.id = id
+        self.slice_index = slice_index
+        self.process_index = slice_index
+        self.platform = "tpu"
+        self.device_kind = "fake"
+        # 2x2 physical topology per slice, so a (dp_ici=2, tp=2) logical mesh
+        # maps without splitting physical axes.
+        self.coords = (id % 2, (id // 2) % 2, 0)
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"FakeDev(id={self.id}, slice={self.slice_index})"
+
+
+def test_hybrid_device_array_multislice_groups_dcn_outer():
+    """dp_dcn>1 branch (create_hybrid_device_mesh): every DCN block of dp rows
+    must hold exactly one slice's devices — tp collectives never cross DCN."""
+    devs = [_FakeSliceDevice(i, i // 4) for i in range(8)]
+    arr = _hybrid_device_array(None, None, 2, devs)  # infer dcn=2, dp_ici=2
+    assert arr.shape == (4, 2)
+    for block in range(2):
+        rows = arr[block * 2 : (block + 1) * 2]
+        slices = {d.slice_index for d in rows.ravel()}
+        assert slices == {block}, f"DCN block {block} mixes slices: {slices}"
+    # tp pairs stay within a slice too (same row => same slice).
+    for row in arr:
+        assert len({d.slice_index for d in row}) == 1
+
+
+def test_hybrid_device_array_multislice_validation():
+    devs = [_FakeSliceDevice(i, i // 4) for i in range(8)]
+    with pytest.raises(ValueError, match="does not divide"):
+        _hybrid_device_array(None, None, 3, devs)
+    with pytest.raises(ValueError, match="!= device count"):
+        _hybrid_device_array(2, 4, 2, devs)
