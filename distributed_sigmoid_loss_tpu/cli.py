@@ -210,6 +210,12 @@ def cmd_train(args) -> int:
         file=sys.stderr,
     )
 
+    if args.loss_family != "sigmoid":
+        import dataclasses
+
+        # The model's t_prime init is family-dependent (CLIP: log(1/0.07));
+        # the loss config lives on the model config so init sees it.
+        cfg = dataclasses.replace(cfg, loss=LossConfig(family=args.loss_family))
     model = SigLIP(cfg)
     tx = make_optimizer(
         TrainConfig(
@@ -313,7 +319,8 @@ def cmd_train(args) -> int:
     step_fn, shardings = make_train_step(
         model,
         mesh,
-        LossConfig(variant=args.variant, precision="default"),
+        LossConfig(variant=args.variant, family=args.loss_family,
+                   precision="default"),
         accum_steps=args.accum,
         zero1=args.zero1,
         ema_decay=args.ema_decay,
@@ -649,6 +656,10 @@ def main(argv=None) -> int:
     tr.add_argument("--steps", type=int, default=20)
     tr.add_argument("--batch", type=int, default=64, help="global batch size")
     tr.add_argument("--variant", choices=["all_gather", "ring"], default="ring")
+    tr.add_argument("--loss-family", choices=["sigmoid", "softmax"],
+                    default="sigmoid",
+                    help="sigmoid = SigLIP (reference); softmax = CLIP/InfoNCE "
+                         "over the same comm variants")
     tr.add_argument("--lr", type=float, default=1e-3)
     tr.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"], default="b16")
     tr.add_argument("--tiny", action="store_true", help="alias for --model tiny")

@@ -26,10 +26,17 @@ class SigLIP(nn.Module):
     def setup(self):
         self.visual = ViT(self.cfg.vision)
         self.textual = TextTransformer(self.cfg.text)
-        # Reference inits: t_prime = log(10), bias = -10
-        # (distributed_sigmoid_loss.py:11-12).
+        # Family-specific inits. Sigmoid (reference): t_prime = log(10),
+        # bias = -10 (distributed_sigmoid_loss.py:11-12). Softmax (CLIP):
+        # t_prime = log(1/0.07) — the open_clip logit-scale contract
+        # (ops/softmax_loss.py); bias exists but is unused (zero grad).
+        t0 = (
+            math.log(1.0 / 0.07)
+            if self.cfg.loss.family == "softmax"
+            else math.log(10.0)
+        )
         self.t_prime = self.param(
-            "t_prime", nn.initializers.constant(math.log(10.0)), (), jnp.float32
+            "t_prime", nn.initializers.constant(t0), (), jnp.float32
         )
         self.bias = self.param(
             "bias", nn.initializers.constant(-10.0), (), jnp.float32

@@ -6,3 +6,7 @@ from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import (  # noqa: F401
     sigmoid_loss_block,
     l2_normalize,
 )
+from distributed_sigmoid_loss_tpu.ops.softmax_loss import (  # noqa: F401
+    init_clip_loss_params,
+    softmax_contrastive_loss,
+)

@@ -1,0 +1,58 @@
+"""Softmax (InfoNCE / CLIP) contrastive loss — the second loss family.
+
+The reference repo ships sigmoid losses only, but exists as an alternative to
+open_clip's softmax ``ClipLoss`` (its ``SigLipLoss`` is a PR against that file;
+rwightman_sigmoid_loss.py:1-10 cites it). A framework replacing the reference
+should offer both families over the same distributed machinery, so users can
+A/B the losses without changing the comm layer:
+
+- this module: the single-device mathematics — symmetric cross-entropy over
+  the (b, b) similarity matrix, ``loss = (CE_rows + CE_cols) / 2``, with the
+  CLIP-standard learnable temperature ``t_prime`` (init ``log(1/0.07)``, no
+  bias).
+- :mod:`distributed_sigmoid_loss_tpu.parallel.contrastive`: the all-gather and
+  ring (online-logsumexp streaming) distributed variants.
+
+Unlike the sigmoid loss, softmax rows are NOT independent of the global batch:
+each row needs a logsumexp over every negative, which is what makes the
+distributed variants interesting (the ring variant streams blocks and keeps a
+running (max, sumexp) pair — the ring-attention recurrence applied to a loss).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "init_clip_loss_params",
+    "softmax_contrastive_loss",
+]
+
+
+def init_clip_loss_params(dtype=jnp.float32) -> dict:
+    """CLIP's learnable temperature: ``t_prime = log(1/0.07)`` (logit scale
+    ``exp(t_prime) ≈ 14.3``), no bias — the open_clip ``ClipLoss`` contract."""
+    return {"t_prime": jnp.asarray(math.log(1.0 / 0.07), dtype=dtype)}
+
+
+def softmax_contrastive_loss(
+    zimg: jax.Array,
+    ztxt: jax.Array,
+    t_prime: jax.Array,
+    *,
+    precision=lax.Precision.HIGHEST,
+) -> jax.Array:
+    """Symmetric InfoNCE over L2-normalized embeddings (single device).
+
+    ``logits = exp(t_prime) * zimg @ ztxt.T``; positives on the diagonal;
+    ``loss = (mean CE(rows) + mean CE(columns)) / 2``.
+    """
+    logits = jnp.exp(t_prime) * jnp.dot(zimg, ztxt.T, precision=precision)
+    diag = jnp.diagonal(logits)
+    i2t = jax.nn.logsumexp(logits, axis=1) - diag
+    t2i = jax.nn.logsumexp(logits, axis=0) - diag
+    return (jnp.mean(i2t) + jnp.mean(t2i)) / 2

@@ -14,6 +14,10 @@ from distributed_sigmoid_loss_tpu.parallel.allgather_loss import (  # noqa: F401
 from distributed_sigmoid_loss_tpu.parallel.ring_loss import (  # noqa: F401
     ring_sigmoid_loss,
 )
+from distributed_sigmoid_loss_tpu.parallel.contrastive import (  # noqa: F401
+    allgather_contrastive_loss,
+    ring_contrastive_loss,
+)
 from distributed_sigmoid_loss_tpu.parallel.api import (  # noqa: F401
     make_sharded_loss_fn,
 )
@@ -27,6 +31,7 @@ from distributed_sigmoid_loss_tpu.parallel.ulysses_attention import (  # noqa: F
 )
 from distributed_sigmoid_loss_tpu.parallel.pipeline import (  # noqa: F401
     gpipe,
+    one_f_one_b,
     make_layer_stage_fn,
     stack_stage_params,
 )

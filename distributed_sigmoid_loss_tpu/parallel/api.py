@@ -22,13 +22,63 @@ from jax import shard_map
 from distributed_sigmoid_loss_tpu.parallel.allgather_loss import allgather_sigmoid_loss
 from distributed_sigmoid_loss_tpu.parallel.ring_loss import ring_sigmoid_loss
 
-__all__ = ["make_sharded_loss_fn"]
+__all__ = ["make_per_shard_loss", "make_sharded_loss_fn"]
+
+
+def make_per_shard_loss(
+    *,
+    family: Literal["sigmoid", "softmax"] = "sigmoid",
+    variant: Literal["all_gather", "ring"] = "all_gather",
+    axis_name: str = "dp",
+    bidir: bool = True,
+    precision=lax.Precision.HIGHEST,
+    use_pallas: bool = False,
+) -> Callable:
+    """The ONE family/variant dispatch, shared by :func:`make_sharded_loss_fn`
+    and the train step — returns ``per_shard(zimg, ztxt, t_prime, bias)`` for
+    use inside ``shard_map`` (``bias`` is ignored by the softmax family, which
+    has no bias term)."""
+    if family not in ("sigmoid", "softmax"):
+        raise ValueError(f"unknown family: {family!r}")
+    if variant not in ("all_gather", "ring"):
+        raise ValueError(f"unknown loss variant: {variant!r}")
+
+    if family == "softmax":
+        from distributed_sigmoid_loss_tpu.parallel.contrastive import (
+            allgather_contrastive_loss,
+            ring_contrastive_loss,
+        )
+
+        if use_pallas:
+            raise ValueError("use_pallas applies to the sigmoid family only")
+        fn = {
+            "all_gather": allgather_contrastive_loss,
+            "ring": ring_contrastive_loss,
+        }[variant]
+
+        def per_shard(zimg, ztxt, t_prime, bias=None):
+            del bias  # InfoNCE has no bias term
+            return fn(zimg, ztxt, t_prime, axis_name=axis_name, precision=precision)
+
+        return per_shard
+
+    if variant == "all_gather":
+        return partial(
+            allgather_sigmoid_loss,
+            axis_name=axis_name, precision=precision, use_pallas=use_pallas,
+        )
+    return partial(
+        ring_sigmoid_loss,
+        axis_name=axis_name, bidir=bidir, precision=precision,
+        use_pallas=use_pallas,
+    )
 
 
 def make_sharded_loss_fn(
     mesh: Mesh,
     *,
     variant: Literal["all_gather", "ring"] = "all_gather",
+    family: Literal["sigmoid", "softmax"] = "sigmoid",
     axis_name: str = "dp",
     bidir: bool = True,
     precision=lax.Precision.HIGHEST,
@@ -41,32 +91,27 @@ def make_sharded_loss_fn(
       mesh: 1-D (or wider) mesh whose ``axis_name`` axis shards the batch.
       variant: ``"all_gather"`` (reference ``DDPSigmoidLoss``) or ``"ring"``
         (reference ``SigLipLoss``).
-      bidir: ring only — bidirectional paired hops vs unidirectional
+      family: ``"sigmoid"`` (SigLIP, the reference's loss — params
+        ``t_prime``/``bias``) or ``"softmax"`` (CLIP/InfoNCE, the open_clip
+        loss the reference's ring variant was a PR against — params
+        ``t_prime`` only, see ``ops.init_clip_loss_params``; ring streams the
+        logsumexp with the online-softmax recurrence).
+      bidir: sigmoid ring only — bidirectional paired hops vs unidirectional
         (reference rwightman_sigmoid_loss.py:30, default True).
-      params: dict with scalar leaves ``t_prime`` and ``bias``
+      params: dict with scalar leaves ``t_prime`` and (sigmoid only) ``bias``
         (see :func:`distributed_sigmoid_loss_tpu.ops.init_loss_params`).
 
     The returned scalar is the mean over shards of the per-shard loss (each normalized
     by local batch), i.e. exactly the quantity whose gradient the reference computes via
     per-rank backward + ``all_reduce(SUM)/W``.
     """
-
-    if variant == "all_gather":
-        per_shard = partial(
-            allgather_sigmoid_loss,
-            axis_name=axis_name, precision=precision, use_pallas=use_pallas,
-        )
-    elif variant == "ring":
-        per_shard = partial(
-            ring_sigmoid_loss,
-            axis_name=axis_name, bidir=bidir, precision=precision,
-            use_pallas=use_pallas,
-        )
-    else:
-        raise ValueError(f"unknown variant: {variant!r}")
+    per_shard = make_per_shard_loss(
+        family=family, variant=variant, axis_name=axis_name, bidir=bidir,
+        precision=precision, use_pallas=use_pallas,
+    )
 
     def shard_loss(params, zimg, ztxt):
-        loss = per_shard(zimg, ztxt, params["t_prime"], params["bias"])
+        loss = per_shard(zimg, ztxt, params["t_prime"], params.get("bias"))
         return lax.pmean(loss, axis_name)
 
     batch_spec = P(axis_name)

@@ -263,19 +263,15 @@ def make_train_step(
     """
     axis = loss_cfg.axis_name
     precision = _precision(loss_cfg.precision)
-    if loss_cfg.variant == "all_gather":
-        per_shard = partial(
-            allgather_sigmoid_loss,
-            axis_name=axis, precision=precision, use_pallas=loss_cfg.use_pallas,
-        )
-    elif loss_cfg.variant == "ring":
-        per_shard = partial(
-            ring_sigmoid_loss,
-            axis_name=axis, bidir=loss_cfg.bidir, precision=precision,
-            use_pallas=loss_cfg.use_pallas,
-        )
-    else:
-        raise ValueError(f"unknown loss variant: {loss_cfg.variant!r}")
+    # The model's `bias` param plays no role under family="softmax" (zero
+    # grad); the uniform per-shard signature keeps one param tree per model.
+    from distributed_sigmoid_loss_tpu.parallel.api import make_per_shard_loss
+
+    per_shard = make_per_shard_loss(
+        family=loss_cfg.family, variant=loss_cfg.variant, axis_name=axis,
+        bidir=loss_cfg.bidir, precision=precision,
+        use_pallas=loss_cfg.use_pallas,
+    )
 
     # Embeddings enter the loss island sharded over dp, replicated over other axes.
     emb_spec = P(axis)

@@ -18,6 +18,10 @@ class LossConfig:
     """Distributed sigmoid loss knobs (reference constructor args)."""
 
     variant: Literal["all_gather", "ring"] = "ring"
+    # "sigmoid" = SigLIP (the reference's loss); "softmax" = CLIP/InfoNCE (the
+    # open_clip loss the reference's ring variant was a PR against) — same two
+    # comm variants; the model's `bias` param is unused (zero grad) under it.
+    family: Literal["sigmoid", "softmax"] = "sigmoid"
     bidir: bool = True  # rwightman_sigmoid_loss.py:30
     axis_name: str = "dp"
     # HIGHEST = fp32 accumulation for parity gates; DEFAULT = bf16 for throughput.
