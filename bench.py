@@ -86,6 +86,10 @@ def main():
                     help="gradient-accumulation microsteps (scan over microbatches); "
                          "batch is the TOTAL per-chip pairs per optimizer step")
     ap.add_argument("--variant", default="ring", choices=["ring", "all_gather"])
+    ap.add_argument("--loss-family", default="sigmoid",
+                    choices=["sigmoid", "softmax"],
+                    help="sigmoid = SigLIP (headline); softmax = CLIP/InfoNCE "
+                         "over the same comm variant")
     ap.add_argument("--steps-per-call", type=int, default=1, metavar="K",
                     help="fuse K optimizer steps into ONE compiled call "
                          "(lax.fori_loop over the train step) so the host "
@@ -178,6 +182,12 @@ def main():
             vision=dataclasses.replace(cfg.vision, **moe_kw),
             text=dataclasses.replace(cfg.text, **moe_kw),
         )
+    if args.loss_family != "sigmoid":
+        from distributed_sigmoid_loss_tpu.utils.config import LossConfig as _LC
+
+        # The model's t_prime init is family-dependent (CLIP: log(1/0.07)) —
+        # keep bench loss trajectories identical to `train --loss-family`.
+        cfg = dataclasses.replace(cfg, loss=_LC(family=args.loss_family))
     if args.no_text_remat:
         cfg = dataclasses.replace(cfg, text=dataclasses.replace(cfg.text, remat=False))
     if not args.scan_layers:
@@ -221,7 +231,8 @@ def main():
         jax.random.key(0), model, tx, batch, mesh, zero1=args.zero1
     )
     loss_cfg = LossConfig(
-        variant=args.variant, precision=args.precision, use_pallas=args.use_pallas
+        variant=args.variant, family=args.loss_family,
+        precision=args.precision, use_pallas=args.use_pallas,
     )
     step, shardings = make_train_step(
         model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1,
@@ -312,6 +323,7 @@ def main():
         "steps": args.steps,
         "steps_per_call": spc,
         "variant": args.variant,
+        "loss_family": args.loss_family,
         "precision": args.precision,
         "use_pallas": args.use_pallas,
         "remat_policy": cfg.vision.remat_policy,

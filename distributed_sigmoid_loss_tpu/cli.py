@@ -545,6 +545,11 @@ def cmd_export(args) -> int:
     from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
 
     cfg = _model_config(args)
+    if args.loss_family != "sigmoid":
+        import dataclasses
+
+        # Same family wiring as train: the model's t_prime init follows it.
+        cfg = dataclasses.replace(cfg, loss=LossConfig(family=args.loss_family))
     model = SigLIP(cfg)
     n_dev = len(jax.devices())
     if args.what == "forward" and args.ep > 1:
@@ -575,7 +580,9 @@ def cmd_export(args) -> int:
         state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
         moe_aux = args.moe_aux_weight if args.moe_experts else None
         step, shardings = make_train_step(
-            model, mesh, LossConfig(variant=args.variant), moe_aux_weight=moe_aux
+            model, mesh,
+            LossConfig(variant=args.variant, family=args.loss_family),
+            moe_aux_weight=moe_aux,
         )
         batch = jax.device_put(batch, shardings)
         example = (state, batch)
@@ -754,6 +761,10 @@ def main(argv=None) -> int:
     ex.add_argument("--batch", type=int, default=64,
                     help="global batch the artifact is shaped for")
     ex.add_argument("--variant", choices=["all_gather", "ring"], default="ring")
+    ex.add_argument("--loss-family", choices=["sigmoid", "softmax"],
+                    default="sigmoid",
+                    help="loss family baked into the train_step artifact "
+                         "(match the train job's --loss-family)")
     ex.add_argument("--lr", type=float, default=1e-3,
                     help="learning rate baked into the train_step artifact")
     ex.add_argument("--warmup-steps", type=int, default=2000,

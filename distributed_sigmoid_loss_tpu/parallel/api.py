@@ -111,7 +111,11 @@ def make_sharded_loss_fn(
     )
 
     def shard_loss(params, zimg, ztxt):
-        loss = per_shard(zimg, ztxt, params["t_prime"], params.get("bias"))
+        # Sigmoid requires its bias param — fail with the param's name here
+        # rather than an opaque type error inside the loss math; softmax has
+        # no bias term and ignores the slot.
+        bias = params["bias"] if family == "sigmoid" else params.get("bias")
+        loss = per_shard(zimg, ztxt, params["t_prime"], bias)
         return lax.pmean(loss, axis_name)
 
     batch_spec = P(axis_name)

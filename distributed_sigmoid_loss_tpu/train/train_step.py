@@ -17,7 +17,6 @@ SigLIP step. TPU-native structure:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import flax.linen as nn
@@ -28,8 +27,6 @@ from flax.training import train_state
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_sigmoid_loss_tpu.parallel.allgather_loss import allgather_sigmoid_loss
-from distributed_sigmoid_loss_tpu.parallel.ring_loss import ring_sigmoid_loss
 from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
 
 __all__ = [
