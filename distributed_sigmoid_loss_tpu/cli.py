@@ -179,6 +179,7 @@ def cmd_train(args) -> int:
     from distributed_sigmoid_loss_tpu.models import SigLIP
     from distributed_sigmoid_loss_tpu.train import (
         PreemptionGuard,
+        RestoreRequiredError,
         create_train_state,
         latest_step,
         make_optimizer,
@@ -365,18 +366,28 @@ def cmd_train(args) -> int:
         # on a non-finite loss.
         skip = latest_step(args.ckpt_dir) or 0
         with PreemptionGuard() as guard:
-            state, report = train_resilient(
-                state,
-                step_fn,
-                device_batches(skip),
-                total_steps=args.steps,
-                ckpt_dir=args.ckpt_dir,
-                ckpt_every=args.ckpt_every,
-                guard=guard,
-                on_metrics=lambda i, m: logger.log(
-                    i, {k: float(v) for k, v in m.items()}
-                ),
-            )
+            try:
+                state, report = train_resilient(
+                    state,
+                    step_fn,
+                    device_batches(skip),
+                    total_steps=args.steps,
+                    ckpt_dir=args.ckpt_dir,
+                    ckpt_every=args.ckpt_every,
+                    guard=guard,
+                    # The state was built with zeros=True on the promise that
+                    # train_resilient's restore overwrites it; if the
+                    # checkpoint vanished between latest_step() and restore,
+                    # refuse (BEFORE any step runs) to train from all-zero
+                    # params and overwrite --ckpt-dir with garbage.
+                    require_restore=resuming,
+                    on_metrics=lambda i, m: logger.log(
+                        i, {k: float(v) for k, v in m.items()}
+                    ),
+                )
+            except RestoreRequiredError as e:
+                print(f"--ckpt-dir {args.ckpt_dir}: {e}", file=sys.stderr)
+                return 1
         print(
             f"resilient loop: steps {report.start_step}->{report.final_step}, "
             f"checkpoints at {report.checkpoints}"

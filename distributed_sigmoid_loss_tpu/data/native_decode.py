@@ -29,7 +29,23 @@ import numpy as np
 
 from distributed_sigmoid_loss_tpu.data.native_loader import build_shared_lib
 
-__all__ = ["native_decode_available", "decode_batch"]
+__all__ = ["native_decode_available", "decode_batch", "default_decode_threads"]
+
+
+def default_decode_threads() -> int:
+    """Per-flush thread cap when the caller doesn't pass ``threads``.
+
+    ``DSL_DECODE_THREADS`` overrides; the default halves ``cpu_count`` (min 1)
+    so two concurrent loaders (e.g. train + eval iterators flushing at once)
+    don't oversubscribe the host — each flush spawns raw ``std::thread``s.
+    """
+    env = os.environ.get("DSL_DECODE_THREADS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            warnings.warn(f"DSL_DECODE_THREADS={env!r} is not an int; ignoring")
+    return max(1, (os.cpu_count() or 1) // 2)
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -94,7 +110,7 @@ def decode_batch(
         lens = (ctypes.c_int64 * n)(*[len(b) for b in blobs])
         fail = (ctypes.c_uint8 * n)()
         if threads is None:
-            threads = min(n, os.cpu_count() or 1)
+            threads = min(n, default_decode_threads())
         lib.dsl_jpeg_decode_batch(
             ctypes.cast(datas, ctypes.POINTER(ctypes.c_char_p)),
             lens,

@@ -54,10 +54,13 @@ def allgather_contrastive_loss(
     rows = jnp.arange(local_b)
     pos_col = idx * local_b + rows
 
-    i2t_logits = scale * jnp.dot(zimg, all_txt.T, precision=precision)
+    # f32 logits before the logsumexp so bf16 embedding runs keep the same
+    # numerics as the ring variant (which upcasts its blocks identically).
+    f32 = jnp.float32
+    i2t_logits = (scale * jnp.dot(zimg, all_txt.T, precision=precision)).astype(f32)
     i2t = jax.nn.logsumexp(i2t_logits, axis=1) - i2t_logits[rows, pos_col]
 
-    t2i_logits = scale * jnp.dot(ztxt, all_img.T, precision=precision)
+    t2i_logits = (scale * jnp.dot(ztxt, all_img.T, precision=precision)).astype(f32)
     t2i = jax.nn.logsumexp(t2i_logits, axis=1) - t2i_logits[rows, pos_col]
 
     return (jnp.mean(i2t) + jnp.mean(t2i)) / 2

@@ -255,21 +255,35 @@ def one_f_one_b(
             y = stage_fn(params, x_in)
             act_next = y
             # Stash this tick's stage input for our own backward sub-tick
-            # (possibly THIS tick, at the last stage). Invariant that makes the
-            # unmasked write safe on drain ticks (m_f >= M): the clipped index
-            # re-targets slot (M-1) % depth AND the clipped stage-0 feed (plus
-            # upstream stages re-running the same inputs) makes x_in a bitwise
-            # recompute of microbatch M-1's boundary — re-writing identical
-            # bytes over a slot whose backward may still be pending. Zeroing or
-            # otherwise changing invalid-tick activations would corrupt mb
-            # M-1's gradients on every stage but the last; mask with f_valid
-            # if the drain data path ever stops recomputing.
+            # (possibly THIS tick, at the last stage). Drain ticks (m_f >= M)
+            # must not disturb slot (M-1) % depth, whose backward may still be
+            # pending — keep the old slice unless f_valid, so correctness never
+            # depends on the drain path bitwise-recomputing mb M-1's boundary
+            # (it would stop doing so if stage_fn gained dropout/rng).
+            slot = jnp.clip(m_f, 0, num_micro - 1) % stash_depth
+            old_slice = lax.dynamic_index_in_dim(stash, slot, 0, keepdims=False)
             stash = lax.dynamic_update_index_in_dim(
-                stash, x_in, jnp.clip(m_f, 0, num_micro - 1) % stash_depth, 0
+                stash, jnp.where(f_valid, x_in, old_slice), slot, 0
             )
-            # Last stage: loss + cotangent seed for the same microbatch.
-            loss_u, dy_seed = jax.value_and_grad(loss_fn)(y)
+            # Last stage only: loss + cotangent seed for the same microbatch.
+            # lax.cond so the S-1 other stages skip the loss fwd+bwd entirely
+            # (loss_fn is collective-free by contract, so a device-varying
+            # predicate is safe under shard_map).
             is_last = stage == num_stages - 1
+
+            def _seed(yy):
+                l, g = jax.value_and_grad(loss_fn)(yy)
+                return l.astype(jnp.float32), g.astype(yy.dtype)
+
+            loss_u, dy_seed = lax.cond(
+                is_last,
+                _seed,
+                lambda yy: (
+                    pvary(jnp.zeros((), jnp.float32), axis_name),
+                    jnp.zeros_like(yy),
+                ),
+                y,
+            )
             loss_acc = loss_acc + jnp.where(is_last & f_valid, loss_u, 0.0)
 
             # ---- backward sub-tick: mb m_b = u - 2(S-1) + stage ------------

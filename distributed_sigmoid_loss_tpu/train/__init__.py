@@ -15,6 +15,7 @@ from distributed_sigmoid_loss_tpu.train.resilience import (  # noqa: F401
     latest_step,
     restore_latest,
     save_step,
+    RestoreRequiredError,
     train_resilient,
 )
 from distributed_sigmoid_loss_tpu.train.export import (  # noqa: F401

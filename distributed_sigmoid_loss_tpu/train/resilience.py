@@ -39,12 +39,22 @@ from distributed_sigmoid_loss_tpu.train.checkpoint import (
 
 __all__ = [
     "PreemptionGuard",
+    "RestoreRequiredError",
     "TrainingDiverged",
     "latest_step",
     "restore_latest",
     "save_step",
     "train_resilient",
 ]
+
+
+class RestoreRequiredError(FileNotFoundError):
+    """``train_resilient(require_restore=True)`` found nothing to restore.
+
+    A dedicated type so callers can catch the restore failure specifically —
+    a bare ``except FileNotFoundError`` around the training loop would also
+    swallow unrelated missing-file errors from data loaders or checkpointing.
+    """
 
 _STEP_DIR_RE = re.compile(r"^step_(\d{8})$")
 
@@ -193,6 +203,7 @@ def train_resilient(
     on_divergence: str = "halt",  # "halt" | "skip"
     on_metrics: Callable[[int, dict], None] | None = None,
     check_finite_every: int = 1,
+    require_restore: bool = False,
 ) -> tuple[Any, ResilienceReport]:
     """Run ``step_fn`` to ``total_steps`` with checkpoint/resume, preemption
     checkpointing, and divergence detection.
@@ -215,9 +226,20 @@ def train_resilient(
     ``batches`` must be an iterable yielding device-ready batches; on resume it
     should reflect the data position for the resumed step (deterministic
     pipelines can seed by step).
+
+    ``require_restore``: raise :class:`RestoreRequiredError` BEFORE any step runs if no
+    checkpoint restores. Pass True when ``state`` was built as a zeros-filled
+    restore target (``create_train_state(zeros=True)``) — training from it
+    would silently proceed from all-zero params and then overwrite
+    ``ckpt_dir`` with garbage checkpoints.
     """
     report = ResilienceReport()
     resumed = restore_latest(ckpt_dir, state)
+    if resumed is None and require_restore:
+        raise RestoreRequiredError(
+            f"require_restore=True but no checkpoint restores from {ckpt_dir!r} "
+            "(did the checkpoint directory change since resume detection?)"
+        )
     if resumed is not None:
         state, report.start_step = resumed[0], resumed[1]
         report.checkpoints.append(resumed[1])

@@ -23,6 +23,7 @@ from distributed_sigmoid_loss_tpu.train import (
     latest_step,
     restore_latest,
     save_step,
+    RestoreRequiredError,
     train_resilient,
 )
 
@@ -206,3 +207,27 @@ def test_restore_latest_roundtrip(tmp_path):
     assert step == 11
     for a, b in zip(_leaves(state), _leaves(restored)):
         np.testing.assert_allclose(a, b)
+
+
+def test_require_restore_refuses_empty_dir(tmp_path):
+    """require_restore=True on an empty checkpoint dir raises BEFORE any step
+    runs (guards the cli's zeros=True restore-target state against a checkpoint
+    that vanishes between resume detection and restore)."""
+    step_fn, state = _make_step()
+    with pytest.raises(RestoreRequiredError):
+        train_resilient(
+            state, step_fn, _batches(3), total_steps=3,
+            ckpt_dir=str(tmp_path), require_restore=True,
+        )
+    # Nothing trained, nothing written: the dir must stay checkpoint-free.
+    assert latest_step(str(tmp_path)) is None
+
+
+def test_require_restore_accepts_existing_checkpoint(tmp_path):
+    step_fn, state = _make_step()
+    save_step(str(tmp_path), 2, jax.device_get(state))
+    _, report = train_resilient(
+        state, step_fn, _batches(4), total_steps=4,
+        ckpt_dir=str(tmp_path), require_restore=True,
+    )
+    assert report.start_step == 2
