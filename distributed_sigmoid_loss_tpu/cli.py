@@ -83,9 +83,9 @@ def _model_config(args):
 
 
 def _make_training_mesh(args):
-    """The (dp[, ep]) mesh for ``--ep`` topologies — ONE set of rules shared by
-    train and export (an artifact validated under different rules than the job
-    it deploys to is exactly the drift this helper prevents).
+    """The (dp[, ep|pp]) mesh for ``--ep`` / ``--pp`` topologies — ONE set of
+    rules shared by train and export (an artifact validated under different
+    rules than the job it deploys to is exactly the drift this helper prevents).
 
     Returns ``(mesh, None)`` or ``(None, error_message)``.
     """
@@ -93,6 +93,23 @@ def _make_training_mesh(args):
 
     from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
 
+    pp = getattr(args, "pp", 1)
+    if pp > 1:
+        from distributed_sigmoid_loss_tpu.parallel.mesh import (
+            data_axis,
+            make_2d_mesh,
+        )
+        from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
+
+        n_dev = len(jax.devices())
+        if args.ep > 1:
+            return None, "--pp with --ep is not supported (pp towers are dense)"
+        if n_dev % pp:
+            return None, f"--pp {pp} must divide device count {n_dev}"
+        return (
+            make_2d_mesh(n_dev // pp, pp, axis_names=(data_axis, pipeline_axis)),
+            None,
+        )
     if args.ep <= 1:
         return make_mesh(), None
     from distributed_sigmoid_loss_tpu.models.moe import EP_AXIS
@@ -200,6 +217,22 @@ def cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.pp > 1 and args.moe_experts:
+        print("--pp with --moe-experts is not supported (pp towers are dense)",
+              file=sys.stderr)
+        return 2
+    if args.pp > 1 and args.zero1:
+        print("--pp with --zero1 is not supported (ZeRO-1 would re-shard the "
+              "stage-local moments dp-wise every step)", file=sys.stderr)
+        return 2
+    if args.pp_microbatches and args.pp <= 1:
+        print("--pp-microbatches without --pp > 1 would be a silent no-op",
+              file=sys.stderr)
+        return 2
+    if args.pp_microbatches < 0:
+        print(f"--pp-microbatches must be >= 1, got {args.pp_microbatches}",
+              file=sys.stderr)
+        return 2
     mesh, mesh_err = _make_training_mesh(args)
     if mesh_err:
         print(mesh_err, file=sys.stderr)
@@ -210,6 +243,15 @@ def cmd_train(args) -> int:
         + (f" process {pidx}/{pcnt}" if pcnt > 1 else ""),
         file=sys.stderr,
     )
+    if pcnt > 1 and args.batch % pcnt:
+        # --coordinator runs checked this already; a pre-initialized runtime
+        # (TPU pod auto-init) reaches here without that gate. batch is GLOBAL;
+        # an indivisible value would silently train at batch//pcnt*pcnt.
+        print(
+            f"--batch {args.batch} must be divisible by process count {pcnt}",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.loss_family != "sigmoid":
         import dataclasses
@@ -217,6 +259,29 @@ def cmd_train(args) -> int:
         # The model's t_prime init is family-dependent (CLIP: log(1/0.07));
         # the loss config lives on the model config so init sees it.
         cfg = dataclasses.replace(cfg, loss=LossConfig(family=args.loss_family))
+    if args.pp > 1:
+        import dataclasses
+
+        # pp stages are the nn.scan-stacked block params; force scanned towers
+        # (the production configs already are — this covers --tiny, whose
+        # test default is unrolled).
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(cfg.vision, scan_layers=True),
+            text=dataclasses.replace(cfg.text, scan_layers=True),
+        )
+        # Validate BEFORE create_train_state: a full b16-class param init costs
+        # minutes, and every other bad flag combination exits 2 with a message.
+        from distributed_sigmoid_loss_tpu.parallel.pp_towers import (
+            validate_pp_tower,
+        )
+
+        try:
+            validate_pp_tower(cfg.vision, args.pp, "vision")
+            validate_pp_tower(cfg.text, args.pp, "text")
+        except ValueError as e:
+            print(f"--pp {args.pp}: {e}", file=sys.stderr)
+            return 2
     model = SigLIP(cfg)
     tx = make_optimizer(
         TrainConfig(
@@ -231,15 +296,15 @@ def cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if (args.data_dir or args.data_shards) and pcnt > 1:
-        # Real-data multihost needs per-host shard striping + local-rows
-        # assembly (ImageTextShards(shard_index=...) + global_batch_from_local)
-        # rather than the same-global-batch-everywhere model place() implements;
-        # wire it with the library API, not this convenience entry point.
+    if args.data_dir and pcnt > 1:
+        # A plain folder has no shard structure to stripe across hosts; the
+        # multi-host real-data path is --data-shards (tar shards stripe
+        # process-wise, the reference's per-rank slicing scaled to files —
+        # test_distributed_sigmoid_loss.py:57-68).
         print(
-            "--data-dir/--data-shards are single-process flags; for multi-host "
-            "real-data training use data.ImageTextShards(shard_index=process, "
-            "num_shards=process_count) with data.global_batch_from_local",
+            "--data-dir is a single-process flag; for multi-host real-data "
+            "training pack the data as tar shards and use --data-shards "
+            "(shards stripe across processes)",
             file=sys.stderr,
         )
         return 2
@@ -281,8 +346,22 @@ def cmd_train(args) -> int:
                 print(f"--data-shards matched nothing: {args.data_shards!r}",
                       file=sys.stderr)
                 return 2
+            if pcnt > 1 and len(shards) < pcnt:
+                print(
+                    f"--data-shards matched {len(shards)} tar(s) for {pcnt} "
+                    "processes; every process needs at least one shard in its "
+                    "stripe",
+                    file=sys.stderr,
+                )
+                return 2
+            # Multi-process: each host reads its own shard stripe (i, i+N, ...)
+            # and contributes batch/num_processes LOCAL rows per step; place()
+            # assembles them into the global array with zero cross-host data
+            # movement (global_batch_from_local).
             source = ImageTextShards(
-                shards, cfg, args.batch, tokenize, native_decode=native_decode,
+                shards, cfg, args.batch // pcnt, tokenize,
+                shard_index=pidx, num_shards=pcnt,
+                native_decode=native_decode,
                 shuffle_buffer=args.shuffle_buffer,
             )
     elif args.native_data:
@@ -313,9 +392,15 @@ def cmd_train(args) -> int:
     # restore target — zeros=True skips the (minutes-long on b16-class towers)
     # random init that the checkpoint would immediately overwrite.
     resuming = bool(args.ckpt_dir) and latest_step(args.ckpt_dir) is not None
+    pp_micro = 0
+    if args.pp > 1:
+        # Default microbatch count 2x stages: enough to keep the bubble
+        # fraction (S-1)/(S+M-1) under a third without shrinking per-call work.
+        pp_micro = args.pp_microbatches or 2 * args.pp
     state = create_train_state(
         jax.random.key(0), model, tx, first, mesh, zero1=args.zero1,
         ema=args.ema_decay is not None, zeros=resuming,
+        pp_axis="pp" if args.pp > 1 else None,
     )
     step_fn, shardings = make_train_step(
         model,
@@ -330,13 +415,21 @@ def cmd_train(args) -> int:
             if args.moe_experts
             else None
         ),
+        pp_microbatches=pp_micro,
     )
 
     logger = MetricsLogger(every=args.log_every)
 
+    # Striped-shard sources already yield this host's LOCAL rows (batch/pcnt
+    # each); synthetic sources yield the same deterministic GLOBAL batch on
+    # every host, which place() slices process-wise.
+    rows_are_local = pcnt > 1 and bool(args.data_shards)
+
     def place(b):
         if pcnt == 1:
             return jax.device_put(b, shardings)
+        if rows_are_local:
+            return global_batch_from_local(b, mesh)
         # Reference-style full-batch-then-slice (test_distributed_sigmoid_loss.py:
         # 57-68): every host generates the same deterministic global batch and
         # contributes the process-order slice its own devices hold.
@@ -439,7 +532,41 @@ def cmd_eval(args) -> int:
     mesh = make_mesh()
     model = SigLIP(cfg)
 
-    batch = next(iter(SyntheticImageText(cfg, args.batch, image_seed=7, text_seed=9)))
+    captions = None
+    if args.data_dir and args.data_shards:
+        print("--data-dir and --data-shards are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    if args.data_dir or args.data_shards:
+        # Real pairs through the SAME loaders train uses; captions ride along
+        # as the zero-shot class names (see below).
+        from distributed_sigmoid_loss_tpu.data import (
+            ImageTextFolder,
+            ImageTextShards,
+        )
+
+        tokenize = _byte_tokenize_for(cfg)
+        if args.data_dir:
+            source = ImageTextFolder(
+                args.data_dir, cfg, args.batch, tokenize, keep_captions=True
+            )
+        else:
+            import glob as globmod
+
+            shards = globmod.glob(args.data_shards)
+            if not shards:
+                print(f"--data-shards matched nothing: {args.data_shards!r}",
+                      file=sys.stderr)
+                return 2
+            source = ImageTextShards(
+                shards, cfg, args.batch, tokenize, keep_captions=True
+            )
+        batch = next(iter(source))
+        captions = batch.pop("captions")
+    else:
+        batch = next(
+            iter(SyntheticImageText(cfg, args.batch, image_seed=7, text_seed=9))
+        )
     if args.ckpt_dir:
         # Train writes step-numbered checkpoints of the FULL train state; restore
         # the newest one into a matching structure (optimizer slots are needed
@@ -507,25 +634,34 @@ def cmd_eval(args) -> int:
 
     from distributed_sigmoid_loss_tpu.eval import build_classifier
 
-    n_classes = args.classes
     tokenize = _byte_tokenize_for(cfg)
-
-    classifier = build_classifier(
-        partial(model.apply, {"params": params}, method=SigLIP.encode_text),
+    if captions is not None:
+        # Real data: the batch's distinct captions ARE the label space — each
+        # image's true class is its own caption (caption-matching zero-shot, the
+        # standard retrieval-as-classification eval when no label set exists).
+        class_names = sorted(set(captions))
+        n_classes = len(class_names)
+        class_index = {c: i for i, c in enumerate(class_names)}
+        label_values = np.asarray([class_index[c] for c in captions], np.int32)
+    else:
+        n_classes = args.classes
+        class_names = [f"c{c}" for c in range(n_classes)]
         # Class name first: short context lengths (tiny config: 8 tokens) would
         # truncate a trailing class name out of every prompt, collapsing all
         # classes onto identical token rows.
-        [f"c{c}" for c in range(n_classes)],
+        rng = np.random.default_rng(0)
+        label_values = rng.integers(0, n_classes, zimg.shape[0]).astype(np.int32)
+
+    classifier = build_classifier(
+        partial(model.apply, {"params": params}, method=SigLIP.encode_text),
+        class_names,
         tokenize,
         cfg.text.context_length,
         templates=("{} photo.", "{} image."),
     )
-    rng = np.random.default_rng(0)
-    labels = jnp.asarray(
-        rng.integers(0, n_classes, zimg.shape[0]), jnp.int32
-    )
-    labels = put_batch(labels, mesh)
-    zs = zeroshot_metrics(zimg, classifier, labels, mesh=mesh, ks=(1, 5))
+    labels = put_batch(jnp.asarray(label_values), mesh)
+    ks = tuple(k for k in (1, 5) if k <= n_classes)
+    zs = zeroshot_metrics(zimg, classifier, labels, mesh=mesh, ks=ks)
     out.update({f"zeroshot_{k}": round(float(v), 4) for k, v in zs.items()})
     print(out)
     return 0
@@ -692,6 +828,14 @@ def main(argv=None) -> int:
                     help="GShard routing group size (with --moe-experts): "
                          "capacity is per-group, so smaller groups shrink the "
                          "dispatch tensors for tight HBM budgets (default 512)")
+    tr.add_argument("--pp", type=int, default=1,
+                    help="pipeline-parallel stages: split each tower's block "
+                         "stack into this many gpipe stages over a pp mesh "
+                         "axis (device count must divide; towers must be "
+                         "scanned + dense)")
+    tr.add_argument("--pp-microbatches", type=int, default=0,
+                    help="microbatches per pipelined step (default 2*pp); "
+                         "global batch must divide by dp*pp_microbatches")
     tr.add_argument("--ep", type=int, default=1,
                     help="expert-parallel mesh factor (with --moe-experts): mesh "
                          "becomes (dp = devices/ep, ep); 1 = replicated experts")
@@ -742,6 +886,13 @@ def main(argv=None) -> int:
     ev.add_argument("--tiny", action="store_true", help="alias for --model tiny")
     ev.add_argument("--moe-experts", type=int, default=0,
                     help="match a checkpoint trained with --moe-experts")
+    ev.add_argument("--data-dir", default="",
+                    help="directory of name.jpg + name.txt pairs: score REAL "
+                         "pairs (retrieval + caption-matching zero-shot) "
+                         "instead of synthetic data")
+    ev.add_argument("--data-shards", default="",
+                    help="glob of webdataset-style tar shards (same loaders as "
+                         "train); mutually exclusive with --data-dir")
     ev.add_argument("--cpu-devices", type=int, default=0)
     ev.add_argument("--ckpt-dir", default="", help="restore params from this checkpoint")
     ev.add_argument("--ema", action="store_true",

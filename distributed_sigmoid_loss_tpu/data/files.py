@@ -79,12 +79,17 @@ class _PairBatcher:
     """
 
     def __init__(
-        self, cfg, batch_size: int, tokenize: Callable, native_decode: bool = False
+        self, cfg, batch_size: int, tokenize: Callable, native_decode: bool = False,
+        keep_captions: bool = False,
     ):
         self.cfg = cfg
         self.batch_size = batch_size
         self.tokenize = tokenize
         self.native_decode = native_decode
+        # keep_captions adds the raw caption strings to each batch (a host-side
+        # list, NOT device-transferable) — eval uses them as zero-shot class
+        # names; pop the key before put_batch/device_put.
+        self.keep_captions = keep_captions
         self._blobs: list[bytes] = []
         self._texts: list[str] = []
 
@@ -115,6 +120,8 @@ class _PairBatcher:
                 f"outside vocab_size {self.cfg.text.vocab_size}"
             )
         batch = {"images": images, "tokens": tokens}
+        if self.keep_captions:
+            batch["captions"] = list(self._texts)
         self._blobs, self._texts = [], []
         return batch
 
@@ -136,8 +143,10 @@ class ImageTextFolder:
         tokenize: Callable,
         seed: int | None = 0,
         native_decode: bool = False,
+        keep_captions: bool = False,
     ):
         self.root = root
+        self.keep_captions = keep_captions
         self.cfg = cfg
         self.batch_size = batch_size
         self.tokenize = tokenize
@@ -169,7 +178,8 @@ class ImageTextFolder:
             if rng is not None:
                 rng.shuffle(order)
             batcher = _PairBatcher(
-                self.cfg, self.batch_size, self.tokenize, self.native_decode
+                self.cfg, self.batch_size, self.tokenize, self.native_decode,
+                keep_captions=self.keep_captions,
             )
             for i in order:
                 item = self.items[i]
@@ -206,7 +216,9 @@ class ImageTextShards:
         num_shards: int = 1,
         native_decode: bool = False,
         shuffle_buffer: int = 0,
+        keep_captions: bool = False,
     ):
+        self.keep_captions = keep_captions
         if not shards:
             raise ValueError("no shards given")
         if not (0 <= shard_index < num_shards):
@@ -277,7 +289,8 @@ class ImageTextShards:
             if rng is not None:
                 rng.shuffle(order)
             batcher = _PairBatcher(
-                self.cfg, self.batch_size, self.tokenize, self.native_decode
+                self.cfg, self.batch_size, self.tokenize, self.native_decode,
+                keep_captions=self.keep_captions,
             )
             pairs = self._pairs(order)
             if self.shuffle_buffer:

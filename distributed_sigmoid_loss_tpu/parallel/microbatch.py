@@ -1,0 +1,78 @@
+"""Interleaved microbatch split/merge for dp-sharded global batches.
+
+``(B, ...) -> (m, B/m, ...)`` where microbatch i takes the i-th chunk of every
+device's RESIDENT rows, so the reshuffle is layout-only — a contiguous global
+split would all-to-all the raw batch across the dp axis every step. Shared by
+gradient accumulation (train/train_step.py) and the pipeline-parallel towers
+(parallel/pp_towers.py): one copy of layout-sensitive sharding logic.
+
+``microbatch_merge`` is the exact inverse, so callers that need row order
+preserved end-to-end (the pp towers: the contrastive loss's positive-pair
+diagonal) can split, process, and merge without permuting the batch. Gradient
+accumulation never merges — microbatch composition is semantically free there.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
+
+__all__ = ["microbatch_split", "microbatch_merge"]
+
+
+def microbatch_split(
+    x: jax.Array, m: int, mesh: Mesh, axis_name: str = data_axis,
+    what: str = "microbatches",
+) -> jax.Array:
+    """``(B, ...) -> (m, B/m, ...)``, per-device-chunk interleaved over ``axis_name``.
+
+    ``what`` names the knob in the divisibility error (callers pass their flag
+    name, e.g. "accum_steps" or "pp_microbatches").
+    """
+    has_axis = axis_name in mesh.axis_names
+    d = dict(mesh.shape).get(axis_name, 1)
+    b = x.shape[0]
+    if b % (d * m):
+        raise ValueError(
+            f"batch {b} must divide by mesh {axis_name}={d} x {what}={m}"
+        )
+    c = b // (d * m)
+    y = x.reshape(d, m, c, *x.shape[1:])
+    if has_axis:
+        y = jax.lax.with_sharding_constraint(y, NamedSharding(mesh, P(axis_name)))
+    y = jnp.swapaxes(y, 0, 1)
+    if has_axis:
+        # Pin the transposed layout BEFORE the flattening reshape so GSPMD
+        # keeps the swap local to each device's resident chunk.
+        y = jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(None, axis_name))
+        )
+    y = y.reshape(m, d * c, *x.shape[1:])
+    if has_axis:
+        y = jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(None, axis_name))
+        )
+    return y
+
+
+def microbatch_merge(
+    y: jax.Array, mesh: Mesh, axis_name: str = data_axis
+) -> jax.Array:
+    """Exact inverse of :func:`microbatch_split`."""
+    has_axis = axis_name in mesh.axis_names
+    d = dict(mesh.shape).get(axis_name, 1)
+    m, dc = y.shape[0], y.shape[1]
+    c = dc // d
+    x = y.reshape(m, d, c, *y.shape[2:])
+    if has_axis:
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, axis_name))
+        )
+    x = jnp.swapaxes(x, 0, 1)
+    x = x.reshape(d * m * c, *y.shape[2:])
+    if has_axis:
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(axis_name)))
+    return x

@@ -151,9 +151,33 @@ def zero1_constrain(opt_state: Any, mesh: Mesh, axis_name: str = "dp") -> Any:
     return jax.tree.map(constrain, opt_state)
 
 
+def _with_pp_shardings(
+    abstract_unboxed: Any, shardings: Any, mesh: Mesh, pp_axis: str
+) -> Any:
+    """Shard the scanned block stacks over ``pp`` at rest.
+
+    With pipeline parallelism each chip should HOLD only its stage's layer
+    params — that is the memory story of pp. The scanned block leaves are
+    ``(depth, ...)``; sharding dim 0 over ``pp`` gives stage s the contiguous
+    ``depth/S`` chunk that :func:`parallel.pipeline.stack_stage_params`'s
+    stage-major reshape assigns it, so gpipe's ``in_specs=P("pp")`` is a
+    layout no-op instead of a per-step reshard of replicated weights.
+    """
+    size = dict(mesh.shape)[pp_axis]
+
+    def fix(path, a, s):
+        in_blocks = any(getattr(k, "key", None) == "blocks" for k in path)
+        if in_blocks and a.shape and a.shape[0] >= size and a.shape[0] % size == 0:
+            rest = tuple(s.spec)[1:]
+            return NamedSharding(mesh, P(pp_axis, *rest))
+        return s
+
+    return jax.tree_util.tree_map_with_path(fix, abstract_unboxed, shardings)
+
+
 def init_params(
     rng: jax.Array, model: nn.Module, sample_batch: dict, mesh: Mesh,
-    zeros: bool = False,
+    zeros: bool = False, pp_axis: str | None = None,
 ) -> Any:
     """Initialize model params directly sharded onto the mesh (no host
     round-trip) — the forward-only half of :func:`create_train_state`, for eval
@@ -163,6 +187,9 @@ def init_params(
     zeros — same shapes/dtypes/shardings at a memset's cost. For checkpoint
     *restore targets* (eval, resume) the values are immediately overwritten,
     and running the real init there costs minutes of host RNG on large towers.
+
+    ``pp_axis`` shards the scanned block stacks' leading (depth) axis over
+    that mesh axis — pair with ``make_train_step(pp_microbatches=...)``.
     """
 
     def init_fn(rng):
@@ -173,8 +200,19 @@ def init_params(
     shardings = param_shardings(mesh, abstract)
     # Unbox the Partitioned metadata: shardings now carry the placement info.
     unboxed_shardings = nn.meta.unbox(shardings)
+    # Strip the metadata boxes WITHOUT nn.meta.unbox: under an ambient mesh
+    # (jax.set_mesh) flax's unbox() applies an EAGER with_sharding_constraint,
+    # which rejects abstract (eval_shape'd) leaves.
+    abstract_unboxed = jax.tree.map(
+        lambda x: x.value if isinstance(x, nn.meta.AxisMetadata) else x,
+        abstract,
+        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
+    )
+    if pp_axis is not None:
+        unboxed_shardings = _with_pp_shardings(
+            abstract_unboxed, unboxed_shardings, mesh, pp_axis
+        )
     if zeros:
-        abstract_unboxed = nn.meta.unbox(abstract)
         return jax.jit(
             lambda: jax.tree.map(
                 lambda a: jnp.zeros(a.shape, a.dtype), abstract_unboxed
@@ -196,6 +234,7 @@ def create_train_state(
     axis_name: str = "dp",
     ema: bool = False,
     zeros: bool = False,
+    pp_axis: str | None = None,
 ) -> TrainState:
     """Initialize a full train state, every leaf committed to the mesh.
 
@@ -204,8 +243,10 @@ def create_train_state(
     ``ema=True`` adds an EMA copy of the params (pair with ``ema_decay`` on
     :func:`make_train_step`). ``zeros=True`` builds a zero-filled state (same
     structure/shardings, no random init) — for checkpoint restore targets.
+    ``pp_axis`` shards the block stacks over that axis (see :func:`init_params`);
+    adam moments inherit the placement through the jitted create.
     """
-    params = init_params(rng, model, sample_batch, mesh, zeros=zeros)
+    params = init_params(rng, model, sample_batch, mesh, zeros=zeros, pp_axis=pp_axis)
 
     # Build the optimizer state under jit too, so every leaf (adam moments follow the
     # param shardings — or their ZeRO-1 placement — and scalar counters replicate) is
@@ -233,6 +274,7 @@ def make_train_step(
     zero1: bool = False,
     ema_decay: float | None = None,
     moe_aux_weight: float | None = None,
+    pp_microbatches: int = 0,
 ):
     """Build the jitted ``(state, batch) -> (state, metrics)`` step.
 
@@ -257,6 +299,13 @@ def make_train_step(
     times the mean of the routers' sown load-balancing losses (models/moe.py) to
     the task loss; without it MoE still trains but routing may collapse onto few
     experts.
+
+    ``pp_microbatches > 0`` runs both towers' block stacks through the GPipe
+    schedule over the mesh's ``pp`` axis with that many microbatches per step
+    (parallel/pp_towers.py) — create the state with the matching
+    ``pp_axis="pp"`` so stage params live sharded. Composes with dp (batch
+    stays dp-sharded) and with ``accum_steps`` (each accumulation microbatch is
+    itself pipelined); dense towers only.
     """
     axis = loss_cfg.axis_name
     precision = _precision(loss_cfg.precision)
@@ -285,8 +334,45 @@ def make_train_step(
         check_vma=not loss_cfg.use_pallas,
     )
 
+    if pp_microbatches < 0:
+        raise ValueError(f"pp_microbatches must be >= 0, got {pp_microbatches}")
+    if pp_microbatches:
+        from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
+        from distributed_sigmoid_loss_tpu.parallel.pp_towers import (
+            siglip_forward_pp,
+            validate_pp_tower,
+        )
+
+        if moe_aux_weight is not None:
+            raise ValueError(
+                "pp towers are dense (Block.apply drops sown aux losses); "
+                "moe_aux_weight requires the non-pp path"
+            )
+        if zero1:
+            # zero1_constrain would re-shard the stage-local (pp-sharded) adam
+            # moments dp-wise on every step — defeating both memory stories
+            # with a silent per-step reshard. Refuse until a pp-aware ZeRO
+            # placement exists.
+            raise ValueError("zero1 with pp_microbatches is not supported")
+        if pipeline_axis not in mesh.axis_names:
+            raise ValueError(
+                f"pp_microbatches={pp_microbatches} needs a mesh with a "
+                f"{pipeline_axis!r} axis, got {mesh.axis_names}"
+            )
+        # Fail at build time, not first step: the model must expose its config
+        # (SigLIP does) and both towers must be pipelineable.
+        pp_stages = dict(mesh.shape)[pipeline_axis]
+        validate_pp_tower(model.cfg.vision, pp_stages, "vision")
+        validate_pp_tower(model.cfg.text, pp_stages, "text")
+
     def loss_fn(params, batch):
-        if moe_aux_weight is None:
+        if pp_microbatches:
+            zimg, ztxt, lp = siglip_forward_pp(
+                model.cfg, params, batch["images"], batch["tokens"],
+                mesh=mesh, num_microbatches=pp_microbatches,
+            )
+            aux = jnp.zeros(())
+        elif moe_aux_weight is None:
             zimg, ztxt, lp = model.apply(
                 {"params": params}, batch["images"], batch["tokens"]
             )
@@ -328,34 +414,18 @@ def make_train_step(
             )
             return loss, lp, aux, grads
 
-        d = mesh.shape[axis]
+        # Interleaved per-device-chunk split (parallel/microbatch.py): the
+        # reshuffle is layout-only, no cross-device all-to-all. Microbatch
+        # composition is arbitrary for accumulation, so no inverse merge is
+        # needed — semantically free.
+        from distributed_sigmoid_loss_tpu.parallel.microbatch import (
+            microbatch_split,
+        )
 
-        def split(x):
-            # (B, ...) -> (accum, B/accum, ...) INTERLEAVED per shard: microbatch
-            # i takes the i-th chunk of every device's resident rows, so the
-            # reshuffle is layout-only — a contiguous global split would all-to-all
-            # the raw batch across devices every step. Microbatch composition is
-            # arbitrary for training, so this is semantically free.
-            if x.shape[0] % (d * accum_steps):
-                raise ValueError(
-                    f"global batch {x.shape[0]} must divide by mesh "
-                    f"{axis}={d} x accum_steps={accum_steps}"
-                )
-            c = x.shape[0] // (d * accum_steps)
-            y = x.reshape(d, accum_steps, c, *x.shape[1:])
-            y = jax.lax.with_sharding_constraint(
-                y, NamedSharding(mesh, P(axis))
-            )
-            y = jnp.swapaxes(y, 0, 1)
-            y = jax.lax.with_sharding_constraint(
-                y, NamedSharding(mesh, P(None, axis))
-            )
-            y = y.reshape(accum_steps, d * c, *x.shape[1:])
-            return jax.lax.with_sharding_constraint(
-                y, NamedSharding(mesh, P(None, axis))
-            )
-
-        micro = jax.tree.map(split, batch)
+        micro = jax.tree.map(
+            lambda x: microbatch_split(x, accum_steps, mesh, axis, what="accum_steps"),
+            batch
+        )
 
         def body(carry, mb):
             loss_sum, grad_sum = carry

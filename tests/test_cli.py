@@ -281,3 +281,48 @@ def test_train_on_real_data_dir(tmp_path):
          "--data-shards", str(tmp_path / "nope*.tar")]
     )
     assert proc.returncode == 2 and "matched nothing" in proc.stderr
+
+
+def _make_pair_dir(tmp_path, n=8):
+    """n JPEG+caption pairs; 4 distinct captions so zero-shot has a label space."""
+    from io import BytesIO
+
+    from PIL import Image
+
+    for i in range(n):
+        im = Image.new("RGB", (20, 16), ((i * 31) % 256, (i * 57) % 256, 40))
+        buf = BytesIO()
+        im.save(buf, "JPEG")
+        (tmp_path / f"p{i:03d}.jpg").write_bytes(buf.getvalue())
+        (tmp_path / f"p{i:03d}.txt").write_text(f"a photo of thing {i % 4}")
+    return str(tmp_path)
+
+
+def test_eval_real_data_dir(tmp_path):
+    """eval --data-dir scores ACTUAL image-caption pairs: retrieval over the
+    real pairs plus caption-matching zero-shot (captions as the class set)."""
+    root = _make_pair_dir(tmp_path)
+    proc = _run(
+        ["eval", "--cpu-devices", "4", "--tiny", "--batch", "8",
+         "--data-dir", root]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout.strip().splitlines()[-1]
+    metrics = eval(out)  # the CLI prints a python dict literal
+    assert "i2t_recall@1" in metrics, metrics
+    assert any(k.startswith("zeroshot") for k in metrics), metrics
+    for v in metrics.values():
+        assert 0.0 <= v <= 1.0
+
+
+def test_train_tiny_pp_smoke():
+    """--pp 2 on 8 CPU devices: (dp=4, pp=2) pipelined towers train end-to-end."""
+    proc = _run(
+        ["train", "--cpu-devices", "8", "--tiny", "--steps", "2",
+         "--batch", "16", "--pp", "2"],
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert [l["step"] for l in lines] == [1, 2]
+    assert "mesh: {'dp': 4, 'pp': 2}" in proc.stderr
