@@ -18,9 +18,97 @@ across revisions are comparable.
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 A100_REF_PAIRS_PER_SEC = 1100.0  # open_clip ViT-B/16 A100 bf16 ballpark (no published ref)
+
+
+def _configure_jax() -> None:
+    """One-time jax config shared by every bench mode: mirror JAX_PLATFORMS
+    into the config API (the axon TPU plugin ignores the env var) and enable
+    the persistent compile cache (multi-minute first compiles on the tunneled
+    chip)."""
+    import jax
+
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def probe_backend(attempts: int = 3, timeout_s: float = 240.0) -> str | None:
+    """Fail fast when the accelerator backend is dead; returns an error string.
+
+    Backend-init failures on the tunneled chip come in two flavors — a raised
+    ``UNAVAILABLE: TPU backend setup/compile error`` and an indefinite hang
+    (observed when a prior HBM-thrashing job wedged the tunnel). A throwaway
+    subprocess converts BOTH into a bounded, reportable outcome: the parent's
+    jax stays uninitialized, so a later successful attempt starts clean.
+    Bounded retry with backoff because a recovering tunnel often comes back
+    within minutes. ``DSL_BENCH_PROBE_ATTEMPTS`` / ``DSL_BENCH_PROBE_TIMEOUT``
+    override; attempts=0 skips the probe entirely.
+    """
+    attempts = int(os.environ.get("DSL_BENCH_PROBE_ATTEMPTS", attempts))
+    timeout_s = float(os.environ.get("DSL_BENCH_PROBE_TIMEOUT", timeout_s))
+    if attempts <= 0:
+        return None
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # CPU smoke run: probing the (possibly dead) TPU would be both wrong
+        # and slow — the probe exists to guard real-chip runs.
+        return None
+    code = (
+        "import jax; d = jax.devices();"
+        "import jax.numpy as jnp;"
+        "x = jnp.ones((128, 128));"
+        "v = float((x @ x)[0, 0]);"  # device->host transfer drains the queue
+        "print('PROBE_OK', d[0].device_kind, v)"
+    )
+    last = ""
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(30.0 * attempt)  # 30s, 60s, ... backoff between retries
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            last = f"backend init/compute hung past {timeout_s:.0f}s"
+            continue
+        if r.returncode == 0 and "PROBE_OK" in r.stdout:
+            return None
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        last = tail[-1] if tail else f"probe exited rc={r.returncode}"
+    return f"{last} (after {attempts} attempts)"
+
+
+def emit_backend_error(args, error: str) -> None:
+    """The ONE-JSON-line contract holds even when the backend is dead: a record
+    with value 0 and the failure cause beats a bare traceback for the driver.
+    The metric name matches the mode the invocation asked for, so per-metric
+    record streams never log a spurious datapoint for a bench that never ran."""
+    if getattr(args, "context", 0):
+        metric, unit = f"attn_block_ms_per_layer_s{args.context}", "ms/layer"
+    elif getattr(args, "moe_breakdown", False):
+        metric, unit = "moe_mlp_fwdbwd_ms", "ms"
+    else:
+        metric, unit = (
+            f"siglip_vit{args.model}_train_pairs_per_sec_per_chip",
+            "pairs/s/chip",
+        )
+    print(json.dumps({
+        "metric": metric,
+        "value": 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "error": f"backend unavailable: {error}",
+        "model": args.model,
+        "per_chip_batch": args.batch,
+        "steps": args.steps,
+    }))
 
 # Peak dense bf16 TFLOP/s by TPU generation (public spec sheets), for the MFU figure.
 PEAK_BF16_TFLOPS = {
@@ -67,6 +155,222 @@ def model_forward_flops_per_pair(cfg) -> float:
         return extra_k * 4.0 * tower.mlp_ratio * s * tower.width**2 * tower.depth
 
     return vit + txt + moe_extra(v, s_img) + moe_extra(t, t.context_length)
+
+
+def run_context_bench(args) -> int:
+    """Long-context attention bench: one ViT-B-width transformer block, fwd+bwd,
+    at ``--context`` tokens — the regime the >1024 flash-kernel dispatch
+    envelope (ops/flash_attention.py) was built for but round 2 never executed
+    on hardware. Times each available impl and reports ms/layer + peak HBM:
+
+    - dense: XLA einsum-softmax core (the s² baseline)
+    - flash: blockwise Pallas kernel (TPU only; the long-seq path)
+    - ring@1: the sequence-parallel ring-attention code path at W=1 (a 1-chip
+      host can't scale sp, but its per-hop machinery still executes — this
+      prices the sp overhead against dense at the same shapes)
+
+    Emits ONE JSON line (same contract shape as the train bench; value = best
+    impl's ms/layer, vs_baseline = dense_ms / best_ms, i.e. speedup over dense).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import flax.linen as nn
+
+    from distributed_sigmoid_loss_tpu.models.transformer import Block
+    from distributed_sigmoid_loss_tpu.ops.flash_attention import (
+        flash_attention_available,
+    )
+
+    seq, width, heads = args.context, 768, 12
+    b = max(1, min(args.batch, 4096 // max(seq // 512, 1)))  # keep b*s bounded
+    on_tpu = jax.default_backend() == "tpu"
+
+    def bench_impl(impl, sp_axis=None):
+        if sp_axis is not None:
+            from jax.sharding import Mesh
+
+            # The sp shard_map needs the ambient mesh at EVERY trace,
+            # including init — and under an ambient mesh flax applies the
+            # kernels' (None, "tp") partitioning at param creation, so the
+            # mesh must carry a (size-1) tp axis as well.
+            grid = np.asarray(jax.devices()[:1]).reshape(1, 1)
+            ctx = jax.set_mesh(Mesh(grid, (sp_axis, "tp")))
+        else:
+            import contextlib
+
+            ctx = contextlib.nullcontext()
+        block = Block(width, heads, 4, jnp.bfloat16, attn_impl=impl,
+                      sp_axis=sp_axis)
+        x = jax.random.normal(jax.random.key(0), (b, seq, width), jnp.bfloat16)
+
+        def loss(p, xx):
+            return jnp.sum(block.apply({"params": p}, xx).astype(jnp.float32) ** 2)
+
+        step = jax.jit(jax.value_and_grad(loss))
+
+        def strip(tree):
+            # nn.meta.unbox under an ambient mesh applies an eager sharding
+            # constraint whose tp axis this 1-device sp mesh doesn't have.
+            return jax.tree.map(
+                lambda v: v.value if isinstance(v, nn.meta.AxisMetadata) else v,
+                tree, is_leaf=lambda v: isinstance(v, nn.meta.AxisMetadata),
+            )
+
+        with ctx:
+            params = strip(block.init(jax.random.key(1), x)["params"])
+            v, _ = step(params, x)
+            float(v)  # drain (block_until_ready returns early on axon)
+            n_steps = args.steps
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                v, _ = step(params, x)
+            float(v)
+            dt = time.perf_counter() - t0
+        stats = {}
+        try:
+            ms = jax.local_devices()[0].memory_stats()
+            if ms:
+                stats["peak_hbm_gb"] = round(ms.get("peak_bytes_in_use", 0) / 2**30, 3)
+        except Exception:
+            pass
+        return dt / n_steps * 1000.0, stats
+
+    results = {}
+    dense_ms, dense_stats = bench_impl("dense")
+    results["dense"] = {"ms_per_layer": round(dense_ms, 3), **dense_stats}
+    if on_tpu and flash_attention_available():
+        flash_ms, flash_stats = bench_impl("flash")
+        results["flash"] = {"ms_per_layer": round(flash_ms, 3), **flash_stats}
+    ring_ms, ring_stats = bench_impl("dense", sp_axis="sp")
+    results["ring_sp1"] = {"ms_per_layer": round(ring_ms, 3), **ring_stats}
+
+    best = min(results.values(), key=lambda r: r["ms_per_layer"])
+    record = {
+        "metric": f"attn_block_ms_per_layer_s{seq}",
+        "value": best["ms_per_layer"],
+        "unit": "ms/layer",
+        "vs_baseline": round(dense_ms / best["ms_per_layer"], 3),
+        "context": seq,
+        "batch": b,
+        "width": width,
+        "num_heads": heads,
+        "steps": args.steps,
+        "device_kind": jax.devices()[0].device_kind,
+        "impls": results,
+    }
+    print(json.dumps(record))
+    return 0
+
+
+def run_moe_breakdown(args) -> int:
+    """Attribute the MoE routing tax (VERDICT: MFU 0.30-0.36 vs 0.54 dense)
+    across the layer's stages. Times the EXACT factored functions the layer
+    executes (models/moe.py: router_topk / build_dispatch / expert_apply),
+    fwd+bwd each, at the headline token count (batch x 196 ViT-B/16 patches),
+    plus the dense Mlp baseline at the same shapes. One JSON line; value =
+    full-MoE ms, vs_baseline = dense_ms / moe_ms.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_sigmoid_loss_tpu.models.moe import (
+        build_dispatch,
+        expert_apply,
+        moe_capacity,
+        router_topk,
+    )
+
+    d, hidden = 768, 3072
+    e, k = (args.moe or 4), args.moe_k
+    tokens = args.batch * 196  # ViT-B/16: (224/16)^2 patches per image
+    group_target = args.moe_group_size or 512
+    group = max(g for g in range(1, min(group_target, tokens) + 1)
+                if tokens % g == 0)
+    n_groups = tokens // group
+    capacity = moe_capacity(group, e, k, 1.25)
+
+    key = jax.random.key(0)
+    kx, kr, ki, ko = jax.random.split(key, 4)
+    xg = jax.random.normal(kx, (n_groups, group, d), jnp.bfloat16)
+    wr = jax.random.normal(kr, (d, e), jnp.float32) * 0.02
+    wi = jax.random.normal(ki, (e, d, hidden), jnp.float32) * 0.02
+    wo = jax.random.normal(ko, (e, hidden, d), jnp.float32) * 0.02
+
+    probs, gates, idx = jax.jit(lambda x, w: router_topk(x, w, k))(xg, wr)
+    dispatch, combine = jax.jit(
+        lambda g, i: build_dispatch(g, i, e, capacity)
+    )(gates, idx)
+
+    def timeit(fn, *a):
+        f = jax.jit(fn)
+        v = f(*a)
+        float(jnp.sum(jax.tree.leaves(v)[0]))  # drain
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            v = f(*a)
+        float(jnp.sum(jax.tree.leaves(v)[0]))
+        return (time.perf_counter() - t0) / args.steps * 1000.0
+
+    stages = {}
+    # Each stage fwd+bwd (grad wrt its weights/inputs), matching training cost.
+    stages["router_ms"] = timeit(
+        jax.grad(lambda w: jnp.sum(router_topk(xg, w, k)[1])), wr
+    )
+    stages["dispatch_build_ms"] = timeit(
+        jax.grad(lambda g: jnp.sum(build_dispatch(g, idx, e, capacity)[1])),
+        gates,
+    )
+    stages["expert_einsums_ms"] = timeit(
+        jax.grad(
+            lambda ws: jnp.sum(
+                expert_apply(xg, dispatch, combine, ws[0], ws[1],
+                             jnp.bfloat16).astype(jnp.float32) ** 2
+            )
+        ),
+        (wi, wo),
+    )
+
+    def full_moe(ws):
+        w_r, w_i, w_o = ws
+        _, g, i = router_topk(xg, w_r, k)
+        disp, comb = build_dispatch(g, i, e, capacity)
+        y = expert_apply(xg, disp, comb, w_i, w_o, jnp.bfloat16)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    moe_ms = timeit(jax.grad(full_moe), (wr, wi, wo))
+
+    def dense_mlp(ws):
+        w_i, w_o = ws
+        h = jax.nn.gelu(
+            jnp.einsum("ntd,dh->nth", xg, w_i.astype(jnp.bfloat16)),
+            approximate=True,
+        )
+        y = jnp.einsum("nth,hd->ntd", h, w_o.astype(jnp.bfloat16))
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    dense_ms = timeit(
+        jax.grad(dense_mlp), (wi[0], wo[0])
+    )
+
+    record = {
+        "metric": "moe_mlp_fwdbwd_ms",
+        "value": round(moe_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(dense_ms / moe_ms, 3),
+        "dense_mlp_ms": round(dense_ms, 3),
+        "stages": {k_: round(v_, 3) for k_, v_ in stages.items()},
+        "tokens": tokens,
+        "experts": e,
+        "num_selected": k,
+        "group": group,
+        "capacity": capacity,
+        "steps": args.steps,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(record))
+    return 0
 
 
 def main():
@@ -122,6 +426,18 @@ def main():
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="capture a jax.profiler trace of the timed steps into DIR "
                          "(view with TensorBoard or ui.perfetto.dev)")
+    ap.add_argument("--moe-breakdown", action="store_true",
+                    help="MoE routing-tax breakdown INSTEAD of the train "
+                         "bench: time router / dispatch-build / expert-einsum "
+                         "stages separately (the factored fns the layer runs, "
+                         "models/moe.py) plus the dense-MLP baseline, at the "
+                         "headline token count")
+    ap.add_argument("--context", type=int, default=0, metavar="SEQ",
+                    help="long-context attention bench INSTEAD of the train "
+                         "bench: time one transformer block fwd+bwd at this "
+                         "sequence length for each attention impl (dense, "
+                         "flash kernel when seq qualifies, sp ring at W=1), "
+                         "reporting ms/layer and peak HBM")
     args = ap.parse_args()
     if args.moe == 1 or args.moe < 0:
         ap.error(f"--moe must be >= 2 experts (or 0 for dense), got {args.moe}")
@@ -131,13 +447,19 @@ def main():
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")
 
+    _configure_jax()
+    err = probe_backend()
+    if err is not None:
+        emit_backend_error(args, err)
+        return 1
+
+    if args.context:
+        return run_context_bench(args)
+    if args.moe_breakdown:
+        return run_moe_breakdown(args)
+
     import jax
     import jax.numpy as jnp
-
-    # Persistent compile cache: the ViT-B/16 step takes minutes to compile on the
-    # tunneled chip the first time; subsequent bench runs reuse the executable.
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from distributed_sigmoid_loss_tpu.models import SigLIP
     from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
@@ -358,7 +680,8 @@ def main():
         if hw_tflops is not None:
             record["hw_util"] = round(hw_tflops / peak, 3)
     print(json.dumps(record))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -43,7 +43,80 @@ from jax.ad_checkpoint import checkpoint_name
 # Mesh axis name for expert parallelism (mirrors TP_AXIS in transformer.py).
 EP_AXIS = "ep"
 
-__all__ = ["MoeMlp", "EP_AXIS"]
+__all__ = [
+    "MoeMlp",
+    "EP_AXIS",
+    "router_topk",
+    "build_dispatch",
+    "expert_apply",
+    "moe_capacity",
+]
+
+
+# Pure stages of the MoE layer, factored out so the per-component perf
+# breakdown (bench.py --moe-breakdown) times EXACTLY the code the module runs.
+
+
+def router_topk(xg: jax.Array, wr: jax.Array, k: int):
+    """Router in f32: ``(probs, gates, idx)`` for grouped tokens ``(n, g, d)``."""
+    logits = jnp.einsum("ntd,de->nte", xg.astype(jnp.float32), wr)
+    probs = jax.nn.softmax(logits, axis=-1)  # (n, g, E)
+    gates, idx = jax.lax.top_k(probs, k)  # (n, g, k)
+    if k > 1:
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return probs, gates, idx
+
+
+def moe_capacity(group: int, e: int, k: int, capacity_factor: float) -> int:
+    """Static per-expert buffer: ``min(group, ceil(k·group/E · cf))``."""
+    return min(group, max(1, int(-(-k * group * capacity_factor // e))))
+
+
+def build_dispatch(gates: jax.Array, idx: jax.Array, e: int, capacity: int):
+    """One-hot dispatch/combine tensors from the router's top-k choices.
+
+    Slot positions via a cumulative count in choice-major order within each
+    group: every token's 1st choice outranks any token's 2nd choice (GShard's
+    priority rule), and within a choice earlier tokens win — all static-shape.
+    Returns ``(dispatch (n,g,E,C), combine (n,g,E,C))``.
+    """
+    n_groups, group, k = idx.shape
+    choice_onehot = jax.nn.one_hot(
+        jnp.moveaxis(idx, -1, 1), e, dtype=jnp.float32
+    )  # (n, k, g, E)
+    position = (
+        jnp.cumsum(choice_onehot.reshape(n_groups, k * group, e), axis=1) - 1.0
+    ).reshape(n_groups, k, group, e)
+    slot = jnp.sum(position * choice_onehot, axis=-1).astype(jnp.int32)  # (n, k, g)
+    keep = (slot < capacity).astype(jnp.float32)
+    slot_onehot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32) * keep[
+        ..., None
+    ]  # (n, k, g, C)
+    # Per-choice dispatch (n, k, g, E, C); choices land in disjoint slots so
+    # the sum over k is still one-hot per (E, C) slot.
+    per_choice = jnp.einsum("nkte,nktc->nktec", choice_onehot, slot_onehot)
+    combine = jnp.einsum(
+        "ntk,nktec->ntec", gates.astype(jnp.float32), per_choice
+    )  # gate-weighted
+    dispatch = jnp.sum(per_choice, axis=1)  # (n, g, E, C)
+    return dispatch, combine
+
+
+def expert_apply(xg, dispatch, combine, wi, wo, dtype):
+    """Dispatch-einsum → per-expert MLP → combine-einsum (model dtype)."""
+    expert_in = jnp.einsum(
+        "ntec,ntd->encd", dispatch.astype(dtype), xg.astype(dtype)
+    )
+    # Same checkpoint tag as the dense Mlp (transformer.py): the save_hot /
+    # save_mlp remat policies keep the expert hidden activation, so backward
+    # recompute stops at the elementwise gelu for MoE blocks too.
+    hidden_act = checkpoint_name(
+        jnp.einsum("encd,edh->ench", expert_in, wi.astype(dtype)),
+        "mlp_hidden",
+    )
+    h = nn.gelu(hidden_act, approximate=True)
+    expert_out = jnp.einsum("ench,ehd->encd", h, wo.astype(dtype))
+    return jnp.einsum("ntec,encd->ntd", combine.astype(dtype), expert_out)
 
 
 class MoeMlp(nn.Module):
@@ -98,38 +171,11 @@ class MoeMlp(nn.Module):
         wr = self.param(
             "router", nn.initializers.normal(0.02), (d, e), jnp.float32
         )
-        logits = jnp.einsum("ntd,de->nte", xg.astype(jnp.float32), wr)
-        probs = jax.nn.softmax(logits, axis=-1)  # (n, g, E)
-        gates, idx = jax.lax.top_k(probs, k)  # (n, g, k)
-        if k > 1:
-            gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+        probs, gates, idx = router_topk(xg, wr, k)
 
         # --- Per-group capacity assignment ------------------------------------
-        # Slot positions via a cumulative count in choice-major order within each
-        # group: every token's 1st choice outranks any token's 2nd choice
-        # (GShard's priority rule), and within a choice earlier tokens win —
-        # all static-shape.
-        capacity = min(
-            group, max(1, int(-(-k * group * self.capacity_factor // e)))
-        )
-        choice_onehot = jax.nn.one_hot(
-            jnp.moveaxis(idx, -1, 1), e, dtype=jnp.float32
-        )  # (n, k, g, E)
-        position = (
-            jnp.cumsum(choice_onehot.reshape(n_groups, k * group, e), axis=1) - 1.0
-        ).reshape(n_groups, k, group, e)
-        slot = jnp.sum(position * choice_onehot, axis=-1).astype(jnp.int32)  # (n, k, g)
-        keep = (slot < capacity).astype(jnp.float32)
-        slot_onehot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32) * keep[
-            ..., None
-        ]  # (n, k, g, C)
-        # Per-choice dispatch (n, k, g, E, C); choices land in disjoint slots so
-        # the sum over k is still one-hot per (E, C) slot.
-        per_choice = jnp.einsum("nkte,nktc->nktec", choice_onehot, slot_onehot)
-        combine = jnp.einsum(
-            "ntk,nktec->ntec", gates.astype(jnp.float32), per_choice
-        )  # gate-weighted
-        dispatch = jnp.sum(per_choice, axis=1)  # (n, g, E, C)
+        capacity = moe_capacity(group, e, k, self.capacity_factor)
+        dispatch, combine = build_dispatch(gates, idx, e, capacity)
 
         # --- Load-balancing auxiliary loss (Switch eq. 4, over all tokens) ----
         # f_e: fraction of tokens whose first choice is e; P_e: mean router prob.
@@ -157,19 +203,5 @@ class MoeMlp(nn.Module):
             (e, hidden, d),
             jnp.float32,
         )
-        expert_in = jnp.einsum(
-            "ntec,ntd->encd", dispatch.astype(self.dtype), xg.astype(self.dtype)
-        )
-        # Same checkpoint tag as the dense Mlp (transformer.py): the save_hot /
-        # save_mlp remat policies keep the expert hidden activation, so backward
-        # recompute stops at the elementwise gelu for MoE blocks too.
-        hidden_act = checkpoint_name(
-            jnp.einsum("encd,edh->ench", expert_in, wi.astype(self.dtype)),
-            "mlp_hidden",
-        )
-        h = nn.gelu(hidden_act, approximate=True)
-        expert_out = jnp.einsum("ench,ehd->encd", h, wo.astype(self.dtype))
-        y = jnp.einsum(
-            "ntec,encd->ntd", combine.astype(self.dtype), expert_out
-        )
+        y = expert_apply(xg, dispatch, combine, wi, wo, self.dtype)
         return y.reshape(*lead, d)
