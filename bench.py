@@ -94,6 +94,8 @@ def emit_backend_error(args, error: str) -> None:
         metric, unit = f"attn_block_ms_per_layer_s{args.context}", "ms/layer"
     elif getattr(args, "moe_breakdown", False):
         metric, unit = "moe_mlp_fwdbwd_ms", "ms"
+    elif getattr(args, "step_breakdown", False):
+        metric, unit = "train_step_breakdown_ms", "ms"
     else:
         metric, unit = (
             f"siglip_vit{args.model}_train_pairs_per_sec_per_chip",
@@ -155,6 +157,58 @@ def model_forward_flops_per_pair(cfg) -> float:
         return extra_k * 4.0 * tower.mlp_ratio * s * tower.width**2 * tower.depth
 
     return vit + txt + moe_extra(v, s_img) + moe_extra(t, t.context_length)
+
+
+def _base_model_config(model_name: str):
+    """Base SigLIPConfig for a bench model name — ONE dispatch shared by the
+    train bench and the breakdown modes, so a record's "model" field always
+    names the config that was actually measured."""
+    from distributed_sigmoid_loss_tpu.utils.config import (
+        SigLIPConfig,
+        TextConfig,
+        ViTConfig,
+    )
+
+    if model_name == "l14":
+        # L/14 needs full remat at useful batch sizes (save_hot exceeds v5e HBM).
+        return SigLIPConfig.l14()
+    if model_name == "so400m":
+        # ~878M params: adam state alone is ~10.5G of the 16G HBM; small batch,
+        # full remat.
+        return SigLIPConfig.so400m()
+    if model_name == "tiny":
+        return SigLIPConfig.tiny_test()  # harness smoke config (CPU-runnable)
+    return SigLIPConfig(
+        vision=ViTConfig(remat_policy="save_hot"),
+        text=TextConfig(remat_policy="save_hot"),
+    )
+
+
+def _timeit_ms(fn, args_, steps: int) -> float:
+    """Mean ms/call of ``jax.jit(fn)(*args_)``.
+
+    ``fn`` must RETURN every array whose computation is being measured —
+    returned outputs cannot be dead-code-eliminated, where returning a slice
+    (e.g. ``state.step``) lets XLA drop the very work under test. Sync is a
+    device->host transfer (``jax.block_until_ready`` returns early on the
+    axon tunnel).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(fn)
+
+    def drain(out):
+        leaf = jax.tree.leaves(out)[0]
+        float(jnp.sum(leaf).astype(jnp.float32))
+
+    out = f(*args_)
+    drain(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = f(*args_)
+    drain(out)
+    return (time.perf_counter() - t0) / steps * 1000.0
 
 
 def run_context_bench(args) -> int:
@@ -264,6 +318,167 @@ def run_context_bench(args) -> int:
     return 0
 
 
+def run_step_breakdown(args) -> int:
+    """Where does the train step's time go? Times independently-jitted pieces
+    of the HEADLINE configuration (same model/batch/remat flags as the train
+    bench) so PERF.md's attribution table comes from measurements, not guesses:
+
+    - full_step: the complete jitted (state, batch) -> (state, metrics) step
+    - towers_fwd: model.apply only (no grads, no loss comm)
+    - grads: grad of the full loss (towers fwd+bwd+loss, no update)
+    - optimizer: apply_gradients on precomputed grads
+    - loss_island: the shard_map'd loss fwd+bwd on precomputed embeddings
+    - attn_stack / mlp_stack: depth x Attention-only / Mlp-only towers at the
+      vision shapes, fwd+bwd (the two compute families inside a block)
+
+    Every timed program RETURNS its full outputs (see _timeit_ms: anything not
+    returned is dead-code-eliminable, which would time a hollowed-out program).
+    Sub-timings need not sum to full_step (XLA fuses differently per program,
+    remat recompute lands in `grads`); the value is the RATIO structure. One
+    JSON line; value = full_step ms, vs_baseline = 1.0 by construction.
+    `--profile` is not consumed here — capture traces with a separate
+    train-bench run.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    import flax.linen as nn
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.models.transformer import (
+        Attention,
+        Mlp,
+        _remat_policy,
+    )
+    from distributed_sigmoid_loss_tpu.parallel.api import make_sharded_loss_fn
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev)
+    cfg = _base_model_config(args.model)
+    if args.model != "tiny":
+        # Unrolled stacks: the measured-fastest headline config (docs/PERF.md).
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(cfg.vision, scan_layers=False),
+            text=dataclasses.replace(cfg.text, scan_layers=False),
+        )
+    model = SigLIP(cfg)
+    tx = make_optimizer(TrainConfig(warmup_steps=100, total_steps=100_000))
+    global_b = args.batch * n_dev  # same convention as the train bench
+    key = jax.random.key(0)
+    batch = {
+        "images": jax.random.normal(
+            key, (global_b, cfg.vision.image_size, cfg.vision.image_size, 3),
+            jnp.float32,
+        ),
+        "tokens": jax.random.randint(
+            key, (global_b, cfg.text.context_length), 0, cfg.text.vocab_size,
+            jnp.int32,
+        ),
+    }
+    state = create_train_state(key, model, tx, batch, mesh)
+    step, shardings = make_train_step(
+        model, mesh, LossConfig(variant=args.variant, precision="default")
+    )
+    batch = jax.device_put(batch, shardings)
+    n_steps = args.steps
+
+    parts = {}
+    # Full outputs (new state + metrics) returned -> nothing DCE-able.
+    parts["full_step_ms"] = _timeit_ms(step, (state, batch), n_steps)
+
+    parts["towers_fwd_ms"] = _timeit_ms(
+        lambda p, bt: model.apply({"params": p}, bt["images"], bt["tokens"]),
+        (state.params, batch), n_steps,
+    )
+
+    loss_fn = make_sharded_loss_fn(
+        mesh, variant=args.variant, precision="default", jit=False
+    )
+
+    def full_loss(p, bt):
+        zimg, ztxt, lp = model.apply({"params": p}, bt["images"], bt["tokens"])
+        return loss_fn({"t_prime": lp["t_prime"], "bias": lp["bias"]}, zimg, ztxt)
+
+    grads = jax.jit(jax.grad(full_loss))(state.params, batch)
+    # Full grads tree returned -> the whole tower backward is live.
+    parts["grads_ms"] = _timeit_ms(
+        lambda p, bt: jax.grad(full_loss)(p, bt), (state.params, batch), n_steps
+    )
+
+    # Full new state returned -> the adam/clip update is live.
+    parts["optimizer_ms"] = _timeit_ms(
+        lambda s_, g: s_.apply_gradients(grads=g), (state, grads), n_steps
+    )
+
+    zimg, ztxt, lp = jax.jit(model.apply)(
+        {"params": state.params}, batch["images"], batch["tokens"]
+    )
+    parts["loss_island_ms"] = _timeit_ms(
+        lambda zi, zt: jax.value_and_grad(
+            lambda z: loss_fn(
+                {"t_prime": lp["t_prime"], "bias": lp["bias"]}, z, zt
+            )
+        )(zi),
+        (zimg, ztxt), n_steps,
+    )
+
+    # The two compute families inside a block, isolated: depth x Attention and
+    # depth x Mlp at the vision shapes, fwd+bwd, same remat policy.
+    v = cfg.vision
+    s_img = (v.image_size // v.patch_size) ** 2
+    x_tokens = jax.random.normal(key, (global_b, s_img, v.width), jnp.bfloat16)
+
+    def stack_time(module):
+        xp = nn.meta.unbox(module.init(jax.random.key(1), x_tokens)["params"])
+        apply_one = lambda p, xx: module.apply({"params": p}, xx)
+        if v.remat:
+            apply_one = jax.checkpoint(
+                apply_one, policy=_remat_policy(v.remat_policy),
+                prevent_cse=False,
+            )
+
+        def loss(p, xx):
+            for _ in range(v.depth):
+                xx = apply_one(p, xx)
+            return jnp.sum(xx.astype(jnp.float32) ** 2)
+
+        return _timeit_ms(
+            lambda p: jax.grad(loss)(p, x_tokens), (xp,), n_steps
+        )
+
+    parts["attn_stack_ms"] = stack_time(
+        Attention(v.width, v.num_heads, jnp.bfloat16, attn_impl=v.attn_impl)
+    )
+    parts["mlp_stack_ms"] = stack_time(Mlp(v.width, v.mlp_ratio, jnp.bfloat16))
+
+    record = {
+        "metric": "train_step_breakdown_ms",
+        "value": round(parts["full_step_ms"], 2),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "parts": {k: round(vl, 2) for k, vl in parts.items()},
+        "model": args.model,
+        "per_chip_batch": args.batch,
+        "global_batch": global_b,
+        "n_devices": n_dev,
+        "variant": args.variant,
+        "steps": n_steps,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(record))
+    return 0
+
+
 def run_moe_breakdown(args) -> int:
     """Attribute the MoE routing tax (VERDICT: MFU 0.30-0.36 vs 0.54 dense)
     across the layer's stages. Times the EXACT factored functions the layer
@@ -304,14 +519,7 @@ def run_moe_breakdown(args) -> int:
     )(gates, idx)
 
     def timeit(fn, *a):
-        f = jax.jit(fn)
-        v = f(*a)
-        float(jnp.sum(jax.tree.leaves(v)[0]))  # drain
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            v = f(*a)
-        float(jnp.sum(jax.tree.leaves(v)[0]))
-        return (time.perf_counter() - t0) / args.steps * 1000.0
+        return _timeit_ms(fn, a, args.steps)
 
     stages = {}
     # Each stage fwd+bwd (grad wrt its weights/inputs), matching training cost.
@@ -426,6 +634,12 @@ def main():
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="capture a jax.profiler trace of the timed steps into DIR "
                          "(view with TensorBoard or ui.perfetto.dev)")
+    ap.add_argument("--step-breakdown", action="store_true",
+                    help="train-step time attribution INSTEAD of the train "
+                         "bench: time the full step, towers-forward, "
+                         "grads-only, optimizer-only, the loss island, and "
+                         "per-layer attention/MLP stacks at the same shapes — "
+                         "the where-the-time-goes table for PERF.md")
     ap.add_argument("--moe-breakdown", action="store_true",
                     help="MoE routing-tax breakdown INSTEAD of the train "
                          "bench: time router / dispatch-build / expert-einsum "
@@ -457,6 +671,8 @@ def main():
         return run_context_bench(args)
     if args.moe_breakdown:
         return run_moe_breakdown(args)
+    if args.step_breakdown:
+        return run_step_breakdown(args)
 
     import jax
     import jax.numpy as jnp
@@ -471,28 +687,13 @@ def main():
     from distributed_sigmoid_loss_tpu.utils.config import (
         LossConfig,
         SigLIPConfig,
-        TextConfig,
         TrainConfig,
-        ViTConfig,
     )
 
     n_dev = len(jax.devices())
     mesh = make_mesh(n_dev)
 
-    if args.model == "l14":
-        # L/14 needs full remat at useful batch sizes (save_hot exceeds v5e HBM).
-        cfg = SigLIPConfig.l14()
-    elif args.model == "so400m":
-        # ~878M params: adam state alone is ~10.5G of the 16G HBM; small batch,
-        # full remat.
-        cfg = SigLIPConfig.so400m()
-    elif args.model == "tiny":
-        cfg = SigLIPConfig.tiny_test()  # harness smoke config (CPU-runnable)
-    else:
-        cfg = SigLIPConfig(
-            vision=ViTConfig(remat_policy="save_hot"),
-            text=TextConfig(remat_policy="save_hot"),
-        )
+    cfg = _base_model_config(args.model)
     import dataclasses
 
     if args.moe:
