@@ -361,10 +361,26 @@ def run_step_breakdown(args) -> int:
     )
     from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
 
+    # Flags this mode cannot honor are REFUSED (a silently different program
+    # would poison the attribution table); the ones that change the compiled
+    # step (family/precision/pallas/scan/mu-bf16) are threaded through.
+    unsupported = {
+        "--accum": args.accum != 1, "--zero1": args.zero1,
+        "--moe": bool(args.moe), "--no-text-remat": args.no_text_remat,
+        "--steps-per-call": args.steps_per_call != 1,
+    }
+    bad = [k for k, v_ in unsupported.items() if v_]
+    if bad:
+        print(f"--step-breakdown does not support {' '.join(bad)}; run the "
+              "train bench for those configurations", file=sys.stderr)
+        return 2
+
     n_dev = len(jax.devices())
     mesh = make_mesh(n_dev)
     cfg = _base_model_config(args.model)
-    if args.model != "tiny":
+    if args.loss_family != "sigmoid":
+        cfg = dataclasses.replace(cfg, loss=LossConfig(family=args.loss_family))
+    if args.model != "tiny" and not args.scan_layers:
         # Unrolled stacks: the measured-fastest headline config (docs/PERF.md).
         cfg = dataclasses.replace(
             cfg,
@@ -372,7 +388,10 @@ def run_step_breakdown(args) -> int:
             text=dataclasses.replace(cfg.text, scan_layers=False),
         )
     model = SigLIP(cfg)
-    tx = make_optimizer(TrainConfig(warmup_steps=100, total_steps=100_000))
+    tx = make_optimizer(TrainConfig(
+        warmup_steps=100, total_steps=100_000,
+        adam_mu_dtype="bfloat16" if args.mu_bf16 else None,
+    ))
     global_b = args.batch * n_dev  # same convention as the train bench
     key = jax.random.key(0)
     batch = {
@@ -385,24 +404,24 @@ def run_step_breakdown(args) -> int:
             jnp.int32,
         ),
     }
-    state = create_train_state(key, model, tx, batch, mesh)
-    step, shardings = make_train_step(
-        model, mesh, LossConfig(variant=args.variant, precision="default")
+    loss_cfg = LossConfig(
+        variant=args.variant, family=args.loss_family,
+        precision=args.precision, use_pallas=args.use_pallas,
     )
+    state = create_train_state(key, model, tx, batch, mesh)
+    step, shardings = make_train_step(model, mesh, loss_cfg)
     batch = jax.device_put(batch, shardings)
     n_steps = args.steps
 
     parts = {}
-    # Full outputs (new state + metrics) returned -> nothing DCE-able.
-    parts["full_step_ms"] = _timeit_ms(step, (state, batch), n_steps)
-
     parts["towers_fwd_ms"] = _timeit_ms(
         lambda p, bt: model.apply({"params": p}, bt["images"], bt["tokens"]),
         (state.params, batch), n_steps,
     )
 
     loss_fn = make_sharded_loss_fn(
-        mesh, variant=args.variant, precision="default", jit=False
+        mesh, variant=args.variant, family=args.loss_family,
+        precision=args.precision, use_pallas=args.use_pallas, jit=False,
     )
 
     def full_loss(p, bt):
@@ -433,10 +452,17 @@ def run_step_breakdown(args) -> int:
     )
 
     # The two compute families inside a block, isolated: depth x Attention and
-    # depth x Mlp at the vision shapes, fwd+bwd, same remat policy.
+    # depth x Mlp at the vision shapes, fwd+bwd, same remat policy. Inputs are
+    # dp-sharded like every other piece — unsharded arrays would run the whole
+    # GLOBAL batch per device, inflating these numbers n_dev-fold.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     v = cfg.vision
     s_img = (v.image_size // v.patch_size) ** 2
-    x_tokens = jax.random.normal(key, (global_b, s_img, v.width), jnp.bfloat16)
+    x_tokens = jax.device_put(
+        jax.random.normal(key, (global_b, s_img, v.width), jnp.bfloat16),
+        NamedSharding(mesh, P("dp")),
+    )
 
     def stack_time(module):
         xp = nn.meta.unbox(module.init(jax.random.key(1), x_tokens)["params"])
@@ -461,6 +487,19 @@ def run_step_breakdown(args) -> int:
     )
     parts["mlp_stack_ms"] = stack_time(Mlp(v.width, v.mlp_ratio, jnp.bfloat16))
 
+    # Full step LAST (it consumes `state`): timed through make_train_step's own
+    # jit so donate_argnums=(0,) stays live — re-wrapping in jax.jit would drop
+    # donation and time a step that pays an extra params+opt_state copy the
+    # real train bench never does. State threads through like the train loop.
+    st = state
+    st, metrics = step(st, batch)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        st, metrics = step(st, batch)
+    float(metrics["loss"])
+    parts["full_step_ms"] = (time.perf_counter() - t0) / n_steps * 1000.0
+
     record = {
         "metric": "train_step_breakdown_ms",
         "value": round(parts["full_step_ms"], 2),
@@ -472,6 +511,10 @@ def run_step_breakdown(args) -> int:
         "global_batch": global_b,
         "n_devices": n_dev,
         "variant": args.variant,
+        "loss_family": args.loss_family,
+        "precision": args.precision,
+        "use_pallas": args.use_pallas,
+        "remat_policy": cfg.vision.remat_policy,
         "steps": n_steps,
         "device_kind": jax.devices()[0].device_kind,
     }

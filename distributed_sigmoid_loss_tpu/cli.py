@@ -285,7 +285,8 @@ def cmd_train(args) -> int:
     model = SigLIP(cfg)
     tx = make_optimizer(
         TrainConfig(
-            learning_rate=args.lr, warmup_steps=5, total_steps=max(args.steps, 10)
+            learning_rate=args.lr, warmup_steps=5, total_steps=max(args.steps, 10),
+            optimizer=args.optimizer,
         )
     )
     source = None
@@ -815,6 +816,11 @@ def main(argv=None) -> int:
                     help="sigmoid = SigLIP (reference); softmax = CLIP/InfoNCE "
                          "over the same comm variants")
     tr.add_argument("--lr", type=float, default=1e-3)
+    tr.add_argument("--optimizer", choices=["adamw", "lion", "adafactor"],
+                    default="adamw",
+                    help="optimizer family: adamw (default), lion (half the "
+                         "optimizer state; use ~3-10x smaller --lr), adafactor "
+                         "(factored second moments, biggest-model memory)")
     tr.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"], default="b16")
     tr.add_argument("--tiny", action="store_true", help="alias for --model tiny")
     tr.add_argument("--accum", type=int, default=1, help="grad-accumulation microsteps")

@@ -190,3 +190,10 @@ class TrainConfig:
     # optimizer-memory ladder before ZeRO-1; the second moment stays f32 (its
     # wide dynamic range is what bf16's 8 mantissa bits lose first).
     adam_mu_dtype: str | None = None
+    # Optimizer family. "adamw" is the contrastive-pretraining default;
+    # "lion" stores ONE momentum slot (half adam's state — pairs well with
+    # mu_dtype bf16 for a 4x optimizer-memory cut; prefers ~3-10x smaller lr
+    # and ~3x larger weight_decay than adamw); "adafactor" stores factored
+    # second moments (rows+cols instead of a full matrix per kernel — the
+    # biggest-model memory option; b1/b2/adam_mu_dtype are ignored).
+    optimizer: Literal["adamw", "lion", "adafactor"] = "adamw"

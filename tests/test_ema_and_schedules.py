@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 import pytest
 
 from distributed_sigmoid_loss_tpu.train.ema import (
@@ -123,3 +124,50 @@ def test_ema_in_train_state_end_to_end(tmp_path):
     bare = create_train_state(jax.random.key(0), model, tx, first, mesh)
     with pytest.raises(ValueError, match="ema=True"):
         step(bare, batch)
+
+
+@pytest.mark.parametrize("name", ["adamw", "lion", "adafactor"])
+def test_optimizer_families_train(name):
+    """Each optimizer family drives the toy loss params downhill; lion's state
+    is half adam's (no second moment slot)."""
+    import distributed_sigmoid_loss_tpu as dsl
+    from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import init_loss_params
+
+    rng = np.random.default_rng(0)
+    zi = rng.standard_normal((8, 16)).astype(np.float32)
+    zt = rng.standard_normal((8, 16)).astype(np.float32)
+    zi /= np.linalg.norm(zi, axis=-1, keepdims=True)
+    zt /= np.linalg.norm(zt, axis=-1, keepdims=True)
+
+    cfg = TrainConfig(learning_rate=1e-2 if name != "lion" else 3e-3,
+                      warmup_steps=0, total_steps=100, optimizer=name)
+    tx = make_optimizer(cfg)
+    params = init_loss_params()
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        loss, g = jax.value_and_grad(
+            lambda pp: dsl.sigmoid_loss(zi, zt, pp["t_prime"], pp["bias"])
+        )(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"{name}: {losses[0]} -> {losses[-1]}"
+
+    leaves = len(jax.tree.leaves(opt_state))
+    if name == "lion":
+        adam_leaves = len(jax.tree.leaves(
+            make_optimizer(TrainConfig(optimizer="adamw")).init(params)
+        ))
+        assert leaves < adam_leaves  # one momentum slot, no nu
+
+
+def test_unknown_optimizer_raises():
+    with pytest.raises(ValueError, match="optimizer"):
+        make_optimizer(TrainConfig(optimizer="sgd"))  # type: ignore[arg-type]
