@@ -361,20 +361,6 @@ def run_step_breakdown(args) -> int:
     )
     from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
 
-    # Flags this mode cannot honor are REFUSED (a silently different program
-    # would poison the attribution table); the ones that change the compiled
-    # step (family/precision/pallas/scan/mu-bf16) are threaded through.
-    unsupported = {
-        "--accum": args.accum != 1, "--zero1": args.zero1,
-        "--moe": bool(args.moe), "--no-text-remat": args.no_text_remat,
-        "--steps-per-call": args.steps_per_call != 1,
-    }
-    bad = [k for k, v_ in unsupported.items() if v_]
-    if bad:
-        print(f"--step-breakdown does not support {' '.join(bad)}; run the "
-              "train bench for those configurations", file=sys.stderr)
-        return 2
-
     n_dev = len(jax.devices())
     mesh = make_mesh(n_dev)
     cfg = _base_model_config(args.model)
@@ -515,9 +501,12 @@ def run_step_breakdown(args) -> int:
         "precision": args.precision,
         "use_pallas": args.use_pallas,
         "remat_policy": cfg.vision.remat_policy,
+        "scan_layers": cfg.vision.scan_layers,
         "steps": n_steps,
         "device_kind": jax.devices()[0].device_kind,
     }
+    if args.mu_bf16:
+        record["adam_mu_dtype"] = "bfloat16"
     print(json.dumps(record))
     return 0
 
@@ -703,6 +692,21 @@ def main():
     if args.steps_per_call < 1 or args.steps % args.steps_per_call:
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")
+    if args.step_breakdown:
+        # Flags the breakdown mode cannot honor are refused up front (BEFORE
+        # the possibly-minutes-long backend probe); a silently different
+        # program would poison the attribution table. The flags that change
+        # the compiled step (family/precision/pallas/scan/mu-bf16) are
+        # threaded through instead.
+        unsupported = {
+            "--accum": args.accum != 1, "--zero1": args.zero1,
+            "--moe": bool(args.moe), "--no-text-remat": args.no_text_remat,
+            "--steps-per-call": args.steps_per_call != 1,
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if bad:
+            ap.error(f"--step-breakdown does not support {' '.join(bad)}; "
+                     "run the train bench for those configurations")
 
     _configure_jax()
     err = probe_backend()

@@ -581,7 +581,10 @@ def cmd_eval(args) -> int:
         )
         from distributed_sigmoid_loss_tpu.utils.config import TrainConfig
 
-        tx = make_optimizer(TrainConfig())
+        # The restore target's opt_state tree must match the checkpoint's
+        # optimizer family — lion has one momentum slot, adafactor factored
+        # moments (orbax restore is structure-strict).
+        tx = make_optimizer(TrainConfig(optimizer=args.optimizer))
         # zeros=True: the state is only a restore TARGET (structure + shapes +
         # shardings); running the real random init here costs minutes of host
         # RNG on b16-class towers before the checkpoint overwrites every leaf.
@@ -892,6 +895,10 @@ def main(argv=None) -> int:
     ev.add_argument("--tiny", action="store_true", help="alias for --model tiny")
     ev.add_argument("--moe-experts", type=int, default=0,
                     help="match a checkpoint trained with --moe-experts")
+    ev.add_argument("--optimizer", choices=["adamw", "lion", "adafactor"],
+                    default="adamw",
+                    help="optimizer family the checkpoint was trained with "
+                         "(shapes the restore target's optimizer state)")
     ev.add_argument("--data-dir", default="",
                     help="directory of name.jpg + name.txt pairs: score REAL "
                          "pairs (retrieval + caption-matching zero-shot) "

@@ -326,3 +326,33 @@ def test_train_tiny_pp_smoke():
     lines = [json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")]
     assert [l["step"] for l in lines] == [1, 2]
     assert "mesh: {'dp': 4, 'pp': 2}" in proc.stderr
+
+
+def test_eval_real_data_shards(tmp_path):
+    """eval --data-shards drives the tar-shard loader end to end."""
+    import io
+    import tarfile
+
+    from PIL import Image
+
+    with tarfile.open(str(tmp_path / "s0.tar"), "w") as tf:
+        for i in range(8):
+            im = Image.new("RGB", (20, 16), ((i * 31) % 256, 90, 40))
+            buf = io.BytesIO()
+            im.save(buf, "JPEG")
+            png = buf.getvalue()
+            info = tarfile.TarInfo(f"s{i:04d}.jpg")
+            info.size = len(png)
+            tf.addfile(info, io.BytesIO(png))
+            txt = f"thing {i % 4}".encode()
+            info = tarfile.TarInfo(f"s{i:04d}.txt")
+            info.size = len(txt)
+            tf.addfile(info, io.BytesIO(txt))
+    proc = _run(
+        ["eval", "--cpu-devices", "4", "--tiny", "--batch", "8",
+         "--data-shards", str(tmp_path / "*.tar")]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    metrics = eval(proc.stdout.strip().splitlines()[-1])
+    assert "i2t_recall@1" in metrics, metrics
+    assert any(k.startswith("zeroshot") for k in metrics), metrics

@@ -177,3 +177,70 @@ def test_microbatch_split_merge_roundtrip():
                                       np.asarray(x))
     with pytest.raises(ValueError, match="divide"):
         microbatch_split(x, 3, mesh)
+
+
+def test_pp_composes_with_accum():
+    """pp_microbatches x accum_steps in one step ≡ the plain step (each
+    accumulation microbatch is itself pipelined)."""
+    cfg = pp_config()
+    model = SigLIP(cfg)
+    tx = make_optimizer(TrainConfig(learning_rate=1e-3, warmup_steps=1,
+                                    total_steps=100))
+    batch = tiny_batch(16, cfg)
+
+    mesh_ref = make_mesh(2)
+    state_ref = create_train_state(jax.random.key(0), model, tx, batch, mesh_ref)
+    step_ref, shard_ref = make_train_step(
+        model, mesh_ref, LossConfig(variant="ring"), accum_steps=2
+    )
+    state_ref, m_ref = step_ref(state_ref, jax.device_put(batch, shard_ref))
+
+    mesh_pp = make_2d_mesh(2, 4, axis_names=("dp", "pp"))
+    state_pp = create_train_state(
+        jax.random.key(0), model, tx, batch, mesh_pp, pp_axis="pp"
+    )
+    step_pp, shard_pp = make_train_step(
+        model, mesh_pp, LossConfig(variant="ring"), accum_steps=2,
+        pp_microbatches=2,
+    )
+    state_pp, m_pp = step_pp(state_pp, jax.device_put(batch, shard_pp))
+
+    np.testing.assert_allclose(float(m_pp["loss"]), float(m_ref["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(state_pp.params),
+                    jax.tree.leaves(state_ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_pp_checkpoint_restores_onto_plain_dp_mesh(tmp_path):
+    """A checkpoint written with pp-sharded stage params restores onto a plain
+    dp mesh (elastic restart across topologies — orbax reshards on load)."""
+    from distributed_sigmoid_loss_tpu.train import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    cfg = pp_config()
+    model = SigLIP(cfg)
+    tx = make_optimizer(TrainConfig())
+    batch = tiny_batch(8, cfg)
+
+    mesh_pp = make_2d_mesh(2, 4, axis_names=("dp", "pp"))
+    state_pp = create_train_state(
+        jax.random.key(0), model, tx, batch, mesh_pp, pp_axis="pp"
+    )
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, state_pp)
+
+    mesh_dp = make_mesh(4)
+    target = create_train_state(
+        jax.random.key(1), model, tx, batch, mesh_dp, zeros=True
+    )
+    restored = restore_checkpoint(path, target)
+    for a, b in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(state_pp.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Restored onto the dp mesh: no pp axis in any leaf sharding.
+    leaf = jax.tree.leaves(restored.params["visual"]["encoder"]["blocks"])[0]
+    assert "pp" not in str(leaf.sharding.spec)
