@@ -1,15 +1,26 @@
 """Profiling & timing utilities (SURVEY.md §5: absent in the reference; TPU-native plan
-is ``jax.profiler`` traces + a ``block_until_ready`` throughput harness)."""
+is ``jax.profiler`` traces + a ``block_until_ready`` throughput harness).
+
+``summarize_trace`` turns a captured trace directory into the op-level
+where-the-time-goes table PERF.md wants, offline — no TensorBoard needed:
+``python -m distributed_sigmoid_loss_tpu.utils.profiling /tmp/trace_dir``.
+"""
 
 from __future__ import annotations
 
 import contextlib
+import glob as _glob
+import gzip
+import json
+import os
+import re
 import time
+from collections import defaultdict
 from typing import Callable
 
 import jax
 
-__all__ = ["trace", "time_step", "throughput"]
+__all__ = ["trace", "time_step", "throughput", "summarize_trace"]
 
 
 @contextlib.contextmanager
@@ -40,3 +51,88 @@ def time_step(fn: Callable, *args, warmup: int = 2, iters: int = 10) -> float:
 def throughput(fn: Callable, *args, items_per_call: int, **kw) -> float:
     """Items/sec of a jitted callable (e.g. image-text pairs/sec of a train step)."""
     return items_per_call / time_step(fn, *args, **kw)
+
+
+# -- offline trace summarization ----------------------------------------------
+
+# "%fusion.123", "copy.4", "all-reduce.1" -> their op family; XLA appends
+# numeric ids and jax sometimes a "%" prefix.
+_OP_ID_RE = re.compile(r"^%?([A-Za-z0-9_\-]+?)(?:[._]\d+)*$")
+
+
+def _op_family(name: str) -> str:
+    m = _OP_ID_RE.match(name)
+    return m.group(1) if m else name
+
+
+def summarize_trace(logdir: str, top: int = 15) -> dict:
+    """Aggregate a :func:`trace` capture into per-THREAD op-family time totals.
+
+    Reads every ``*.trace.json.gz`` under ``logdir`` (the Perfetto JSON the
+    profiler writes alongside the XPlane protos — parseable with the stdlib,
+    unlike the protos). Returns ``{"process/thread": [(op_family, total_ms,
+    share), ...]}`` with up to ``top`` rows per track, shares of that TRACK's
+    total.
+
+    Grouping is per (pid, tid), never per process: a device process carries an
+    "XLA Ops" thread (the per-op spans you want) alongside "XLA Modules" /
+    "Steps" threads whose enclosing spans cover the same wall time again —
+    summing them per-process would double/triple-count and bury the op rows
+    under one giant module span. Read the device's "XLA Ops" track for the
+    where-the-time-goes table; host Python tracks still nest internally, so
+    treat their totals as upper bounds for dispatch-gap debugging only.
+    """
+    paths = sorted(
+        _glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"), recursive=True)
+    )
+    if not paths:
+        raise FileNotFoundError(f"no *.trace.json.gz under {logdir!r}")
+    pid_names: dict = {}
+    tid_names: dict = {}
+    totals: dict = defaultdict(lambda: defaultdict(float))
+    for path in paths:
+        with gzip.open(path, "rt") as f:
+            events = json.load(f).get("traceEvents", [])
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pid_names[ev.get("pid")] = ev.get("args", {}).get("name", "?")
+            elif ev.get("ph") == "M" and ev.get("name") == "thread_name":
+                tid_names[(ev.get("pid"), ev.get("tid"))] = ev.get(
+                    "args", {}
+                ).get("name", "?")
+        for ev in events:
+            if ev.get("ph") == "X" and "dur" in ev and ev.get("name"):
+                key = (ev.get("pid"), ev.get("tid"))
+                track = (
+                    f"{pid_names.get(ev.get('pid'), ev.get('pid'))}/"
+                    f"{tid_names.get(key, ev.get('tid'))}"
+                )
+                totals[track][_op_family(ev["name"])] += ev["dur"] / 1000.0
+    out = {}
+    for track, fams in totals.items():
+        track_total = sum(fams.values())
+        rows = sorted(fams.items(), key=lambda kv: -kv[1])[:top]
+        out[track] = [
+            (fam, round(ms, 3), round(ms / track_total, 3) if track_total else 0.0)
+            for fam, ms in rows
+        ]
+    return out
+
+
+def _main() -> int:
+    import sys
+
+    if len(sys.argv) < 2:
+        print("usage: python -m distributed_sigmoid_loss_tpu.utils.profiling "
+              "TRACE_DIR [TOP_N]", file=sys.stderr)
+        return 2
+    top = int(sys.argv[2]) if len(sys.argv) > 2 else 15
+    for track, rows in summarize_trace(sys.argv[1], top=top).items():
+        print(f"\n== {track}")
+        for fam, ms, share in rows:
+            print(f"  {fam:<40} {ms:>10.3f} ms  {share:>6.1%}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())
