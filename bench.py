@@ -553,45 +553,49 @@ def run_moe_breakdown(args) -> int:
     def timeit(fn, *a):
         return _timeit_ms(fn, a, args.steps)
 
+    # Every operand is a jit ARGUMENT, never a closure: closed-over arrays are
+    # embedded in the HLO as literal constants, and at bench token counts (~83MB
+    # of activations) the serialized module exceeds the axon tunnel's
+    # remote-compile request limit (observed: HTTP 413).
     stages = {}
     # Each stage fwd+bwd (grad wrt its weights/inputs), matching training cost.
     stages["router_ms"] = timeit(
-        jax.grad(lambda w: jnp.sum(router_topk(xg, w, k)[1])), wr
+        jax.grad(lambda w, x: jnp.sum(router_topk(x, w, k)[1])), wr, xg
     )
     stages["dispatch_build_ms"] = timeit(
-        jax.grad(lambda g: jnp.sum(build_dispatch(g, idx, e, capacity)[1])),
-        gates,
+        jax.grad(lambda g, i: jnp.sum(build_dispatch(g, i, e, capacity)[1])),
+        gates, idx,
     )
     stages["expert_einsums_ms"] = timeit(
         jax.grad(
-            lambda ws: jnp.sum(
-                expert_apply(xg, dispatch, combine, ws[0], ws[1],
+            lambda ws, x, disp, comb: jnp.sum(
+                expert_apply(x, disp, comb, ws[0], ws[1],
                              jnp.bfloat16).astype(jnp.float32) ** 2
             )
         ),
-        (wi, wo),
+        (wi, wo), xg, dispatch, combine,
     )
 
-    def full_moe(ws):
+    def full_moe(ws, x):
         w_r, w_i, w_o = ws
-        _, g, i = router_topk(xg, w_r, k)
+        _, g, i = router_topk(x, w_r, k)
         disp, comb = build_dispatch(g, i, e, capacity)
-        y = expert_apply(xg, disp, comb, w_i, w_o, jnp.bfloat16)
+        y = expert_apply(x, disp, comb, w_i, w_o, jnp.bfloat16)
         return jnp.sum(y.astype(jnp.float32) ** 2)
 
-    moe_ms = timeit(jax.grad(full_moe), (wr, wi, wo))
+    moe_ms = timeit(jax.grad(full_moe), (wr, wi, wo), xg)
 
-    def dense_mlp(ws):
+    def dense_mlp(ws, x):
         w_i, w_o = ws
         h = jax.nn.gelu(
-            jnp.einsum("ntd,dh->nth", xg, w_i.astype(jnp.bfloat16)),
+            jnp.einsum("ntd,dh->nth", x, w_i.astype(jnp.bfloat16)),
             approximate=True,
         )
         y = jnp.einsum("nth,hd->ntd", h, w_o.astype(jnp.bfloat16))
         return jnp.sum(y.astype(jnp.float32) ** 2)
 
     dense_ms = timeit(
-        jax.grad(dense_mlp), (wi[0], wo[0])
+        jax.grad(dense_mlp), (wi[0], wo[0]), xg
     )
 
     record = {
@@ -660,6 +664,11 @@ def main():
                     help="GShard routing group size (with --moe; default 512): "
                          "capacity is per-group, so smaller groups shrink the "
                          "dispatch tensors for tight HBM budgets")
+    ap.add_argument("--attn-impl", default="auto",
+                    choices=["auto", "dense", "flash"],
+                    help="tower attention core: auto = fused Pallas kernel for "
+                         "bf16 self-attention (VMEM-resident at tower seqs, "
+                         "blockwise flash beyond), dense = plain XLA einsums")
     ap.add_argument("--scan-layers", action="store_true",
                     help="lax.scan over tower depth instead of the unrolled "
                          "default (O(1) compile time in depth, ~1.3%% slower)")
@@ -760,6 +769,12 @@ def main():
         cfg = dataclasses.replace(cfg, loss=_LC(family=args.loss_family))
     if args.no_text_remat:
         cfg = dataclasses.replace(cfg, text=dataclasses.replace(cfg.text, remat=False))
+    if args.attn_impl != "auto":
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(cfg.vision, attn_impl=args.attn_impl),
+            text=dataclasses.replace(cfg.text, attn_impl=args.attn_impl),
+        )
     if not args.scan_layers:
         # Unrolled block stacks are the measured-fastest config (docs/PERF.md);
         # the package default stays scan_layers=True (constant compile time for

@@ -1,8 +1,17 @@
 """ViT image tower (BASELINE.json configs #4/#5: ViT-B/16, ViT-L/14).
 
-Patchify is a strided conv — XLA lowers it to one MXU matmul over (patches × 3·p²).
-Output is the L2-normalizable image embedding; normalization stays OUTSIDE the model,
-matching the reference's convention of normalizing outside the loss
+Patchify is an explicit reshape + ONE MXU matmul, not a strided conv: with
+stride == kernel the conv is mathematically a per-patch dot product, and the
+explicit form makes the MXU lowering visible instead of trusting XLA's conv
+path. Measured A/B on the chip: perf-NEUTRAL vs nn.Conv (773.4 vs 771.6
+pairs/s headline, run noise) — XLA was already lowering this conv well. (A
+trace initially suggested otherwise: `convolution_add_fusion` at 11.8% of
+device time — but on TPU that op name is XLA's label for MATMUL+bias fusions,
+which run at 175 TFLOP/s there; see docs/PERF.md round-3 notes.) Params keep
+nn.Conv's exact HWIO kernel layout so checkpoints are interchangeable with the
+conv form.
+Output is the L2-normalizable image embedding; normalization stays OUTSIDE the
+model, matching the reference's convention of normalizing outside the loss
 (/root/reference/test_distributed_sigmoid_loss.py:96-101, README.md release note).
 """
 
@@ -15,6 +24,41 @@ from distributed_sigmoid_loss_tpu.models.transformer import Encoder, MapHead, _d
 from distributed_sigmoid_loss_tpu.utils.config import ViTConfig
 
 
+class PatchEmbed(nn.Module):
+    """Non-overlapping patchify as reshape + matmul (see module docstring).
+
+    Param tree is identical to ``nn.Conv(width, (p, p), strides=(p, p),
+    padding="VALID")``: ``kernel`` (p, p, 3, width) HWIO + ``bias`` (width,).
+    """
+
+    width: int
+    patch_size: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, images):
+        b, hh, ww, c = images.shape
+        p = self.patch_size
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (p, p, c, self.width),
+            jnp.float32,
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.width,), jnp.float32)
+        # (b, H, W, c) -> (b, nh, p, nw, p, c) -> (b, nh·nw, p·p·c); the
+        # per-patch (ph, pw, c) order matches the HWIO kernel reshape below.
+        x = images.astype(self.dtype)  # promote inputs like nn.Conv(dtype=...) did
+        if hh % p or ww % p:
+            # nn.Conv(padding="VALID") silently cropped the remainder (e.g.
+            # L/14 at 384: 384 % 14 = 6 px); keep that drop-in behavior.
+            x = x[:, : hh // p * p, : ww // p * p, :]
+        x = x.reshape(b, hh // p, p, ww // p, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, (hh // p) * (ww // p), p * p * c)
+        w = kernel.reshape(p * p * c, self.width)
+        return x @ w.astype(self.dtype) + bias.astype(self.dtype)
+
+
 class ViT(nn.Module):
     cfg: ViTConfig
 
@@ -25,21 +69,15 @@ class ViT(nn.Module):
         dtype = _dtype(cfg.dtype)
         x = images.astype(dtype)
 
-        x = nn.Conv(
-            cfg.width,
-            kernel_size=(cfg.patch_size, cfg.patch_size),
-            strides=(cfg.patch_size, cfg.patch_size),
-            padding="VALID",
-            dtype=dtype,
-            name="patch_embed",
+        x = PatchEmbed(
+            cfg.width, cfg.patch_size, dtype, name="patch_embed"
         )(x)
-        b, h, w, c = x.shape
-        x = x.reshape(b, h * w, c)
+        n = x.shape[1]  # patch count from the ACTUAL input (e.g. 384-res finetune)
 
         pos = self.param(
             "pos_embed",
             nn.initializers.normal(stddev=0.02),
-            (1, h * w, cfg.width),
+            (1, n, cfg.width),
             jnp.float32,
         )
         x = x + pos.astype(dtype)

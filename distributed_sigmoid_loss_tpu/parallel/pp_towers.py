@@ -39,6 +39,7 @@ from distributed_sigmoid_loss_tpu.models.transformer import (
     _dtype,
     _remat_policy,
 )
+from distributed_sigmoid_loss_tpu.models.vit import PatchEmbed
 from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import l2_normalize
 from distributed_sigmoid_loss_tpu.parallel.microbatch import (
     microbatch_merge,
@@ -143,15 +144,9 @@ def vision_forward_pp(
     validate_pp_tower(cfg, mesh.shape[axis_name], "vision")
     dtype = _dtype(cfg.dtype)
     x = images.astype(dtype)
-    x = nn.Conv(
-        cfg.width,
-        kernel_size=(cfg.patch_size, cfg.patch_size),
-        strides=(cfg.patch_size, cfg.patch_size),
-        padding="VALID",
-        dtype=dtype,
-    ).apply({"params": params["patch_embed"]}, x)
-    b, h, w, c = x.shape
-    x = x.reshape(b, h * w, c)
+    x = PatchEmbed(cfg.width, cfg.patch_size, dtype).apply(
+        {"params": params["patch_embed"]}, x
+    )
     x = x + params["pos_embed"].astype(dtype)
 
     x = _pipelined_blocks(

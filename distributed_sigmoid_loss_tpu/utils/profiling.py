@@ -20,7 +20,13 @@ from typing import Callable
 
 import jax
 
-__all__ = ["trace", "time_step", "throughput", "summarize_trace"]
+__all__ = [
+    "trace",
+    "time_step",
+    "throughput",
+    "summarize_trace",
+    "summarize_device_ops",
+]
 
 
 @contextlib.contextmanager
@@ -65,6 +71,20 @@ def _op_family(name: str) -> str:
     return m.group(1) if m else name
 
 
+def _read_trace_files(logdir: str):
+    """Yield each ``*.trace.json.gz`` file's parsed events, ONE file at a time
+    (captures are hundreds of MB of Perfetto JSON — holding every parsed file
+    simultaneously would be multi-GB resident; consumers accumulate and drop)."""
+    paths = sorted(
+        _glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"), recursive=True)
+    )
+    if not paths:
+        raise FileNotFoundError(f"no *.trace.json.gz under {logdir!r}")
+    for path in paths:
+        with gzip.open(path, "rt") as f:
+            yield json.load(f).get("traceEvents", [])
+
+
 def summarize_trace(logdir: str, top: int = 15) -> dict:
     """Aggregate a :func:`trace` capture into per-THREAD op-family time totals.
 
@@ -82,41 +102,132 @@ def summarize_trace(logdir: str, top: int = 15) -> dict:
     where-the-time-goes table; host Python tracks still nest internally, so
     treat their totals as upper bounds for dispatch-gap debugging only.
     """
-    paths = sorted(
-        _glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"), recursive=True)
-    )
-    if not paths:
-        raise FileNotFoundError(f"no *.trace.json.gz under {logdir!r}")
-    pid_names: dict = {}
-    tid_names: dict = {}
-    totals: dict = defaultdict(lambda: defaultdict(float))
-    for path in paths:
-        with gzip.open(path, "rt") as f:
-            events = json.load(f).get("traceEvents", [])
+    acc = _TrackAccum()
+    for events in _read_trace_files(logdir):
+        acc.add(events)
+    return acc.finalize(top)
+
+
+class _TrackAccum:
+    """Streaming accumulator behind :func:`summarize_trace` — ``add`` one
+    file's events at a time (so only one parsed file is resident), then
+    ``finalize``."""
+
+    def __init__(self):
+        self.pid_names: dict = {}
+        self.tid_names: dict = {}
+        self.totals: dict = defaultdict(lambda: defaultdict(float))
+
+    def add(self, events) -> None:
         for ev in events:
             if ev.get("ph") == "M" and ev.get("name") == "process_name":
-                pid_names[ev.get("pid")] = ev.get("args", {}).get("name", "?")
+                self.pid_names[ev.get("pid")] = ev.get("args", {}).get("name", "?")
             elif ev.get("ph") == "M" and ev.get("name") == "thread_name":
-                tid_names[(ev.get("pid"), ev.get("tid"))] = ev.get(
+                self.tid_names[(ev.get("pid"), ev.get("tid"))] = ev.get(
                     "args", {}
                 ).get("name", "?")
         for ev in events:
             if ev.get("ph") == "X" and "dur" in ev and ev.get("name"):
                 key = (ev.get("pid"), ev.get("tid"))
                 track = (
-                    f"{pid_names.get(ev.get('pid'), ev.get('pid'))}/"
-                    f"{tid_names.get(key, ev.get('tid'))}"
+                    f"{self.pid_names.get(ev.get('pid'), ev.get('pid'))}/"
+                    f"{self.tid_names.get(key, ev.get('tid'))}"
                 )
-                totals[track][_op_family(ev["name"])] += ev["dur"] / 1000.0
-    out = {}
-    for track, fams in totals.items():
-        track_total = sum(fams.values())
-        rows = sorted(fams.items(), key=lambda kv: -kv[1])[:top]
-        out[track] = [
-            (fam, round(ms, 3), round(ms / track_total, 3) if track_total else 0.0)
-            for fam, ms in rows
+                self.totals[track][_op_family(ev["name"])] += ev["dur"] / 1000.0
+
+    def finalize(self, top: int) -> dict:
+        out = {}
+        for track, fams in self.totals.items():
+            track_total = sum(fams.values())
+            rows = sorted(fams.items(), key=lambda kv: -kv[1])[:top]
+            out[track] = [
+                (fam, round(ms, 3),
+                 round(ms / track_total, 3) if track_total else 0.0)
+                for fam, ms in rows
+            ]
+        return out
+
+
+def summarize_device_ops(logdir: str, top: int = 12) -> dict:
+    """Roofline-grade attribution of device time from a :func:`trace` capture.
+
+    The profiler annotates each device op span with ``hlo_category`` (XLA's own
+    taxonomy), ``model_flops`` and ``bytes_accessed`` — which is the honest
+    attribution axis. Op NAMES mislead on TPU: a ``convolution_add_fusion``
+    there is usually a MATMUL+bias fusion ("convolution" is how XLA:TPU frames
+    dots in fusion names), so name-based tables make matmul time look like conv
+    waste (this bit us: docs/PERF.md round-3 notes).
+
+    Returns ``{"categories": [(category, ms, share, tflops, gbps), ...],
+    "top_ops": [(dedup_name, ms, count, tflops, gbps), ...]}`` where ``tflops``
+    / ``gbps`` are achieved rates over that row's summed span time — compare
+    against peak to see whether a row is MXU-bound, HBM-bound, or neither
+    (kernel overhead).
+    """
+    acc = _DeviceOpAccum()
+    for events in _read_trace_files(logdir):
+        acc.add(events)
+    return acc.finalize(top)
+
+
+class _DeviceOpAccum:
+    """Streaming accumulator behind :func:`summarize_device_ops` (same one-
+    file-resident contract as :class:`_TrackAccum`)."""
+
+    def __init__(self):
+        self.cat = defaultdict(lambda: [0.0, 0.0, 0.0])  # ms, flops, bytes
+        self.ops = defaultdict(lambda: [0.0, 0, 0.0, 0.0])  # ms, n, flops, bytes
+
+    def add(self, events) -> None:
+        tid_names = {
+            (ev.get("pid"), ev.get("tid")): ev.get("args", {}).get("name", "")
+            for ev in events
+            if ev.get("ph") == "M" and ev.get("name") == "thread_name"
+        }
+        for ev in events:
+            if not (
+                ev.get("ph") == "X"
+                and "dur" in ev
+                and tid_names.get((ev.get("pid"), ev.get("tid"))) == "XLA Ops"
+            ):
+                continue
+            a = ev.get("args", {})
+            ms = ev["dur"] / 1000.0
+            fl = float(a.get("model_flops", 0) or 0)
+            by = float(a.get("bytes_accessed", 0) or 0)
+            c = self.cat[a.get("hlo_category", _op_family(ev["name"]))]
+            c[0] += ms
+            c[1] += fl
+            c[2] += by
+            o = self.ops[a.get("deduplicated_name", ev["name"])]
+            o[0] += ms
+            o[1] += 1
+            o[2] += fl
+            o[3] += by
+
+    def finalize(self, top: int) -> dict:
+        def rates(ms, fl, by):
+            s = ms / 1000.0
+            return (
+                round(fl / s / 1e12, 1) if s else 0.0,
+                round(by / s / 2**30, 0) if s else 0.0,
+            )
+
+        total = sum(v[0] for v in self.cat.values())
+        categories = [
+            (name, round(ms, 1), round(ms / total, 3) if total else 0.0,
+             *rates(ms, fl, by))
+            for name, (ms, fl, by) in sorted(
+                self.cat.items(), key=lambda kv: -kv[1][0]
+            )
         ]
-    return out
+        top_ops = [
+            (name, round(ms, 1), n, *rates(ms, fl, by))
+            for name, (ms, n, fl, by) in sorted(
+                self.ops.items(), key=lambda kv: -kv[1][0]
+            )[:top]
+        ]
+        return {"categories": categories, "top_ops": top_ops}
 
 
 def _main() -> int:
@@ -127,10 +238,25 @@ def _main() -> int:
               "TRACE_DIR [TOP_N]", file=sys.stderr)
         return 2
     top = int(sys.argv[2]) if len(sys.argv) > 2 else 15
-    for track, rows in summarize_trace(sys.argv[1], top=top).items():
+    # ONE streaming pass: each file is parsed once and fed to both
+    # accumulators, so peak memory is a single file's parsed events.
+    tracks, device = _TrackAccum(), _DeviceOpAccum()
+    for events in _read_trace_files(sys.argv[1]):
+        tracks.add(events)
+        device.add(events)
+    for track, rows in tracks.finalize(top).items():
         print(f"\n== {track}")
         for fam, ms, share in rows:
             print(f"  {fam:<40} {ms:>10.3f} ms  {share:>6.1%}")
+    dev = device.finalize(top)
+    if dev["categories"]:
+        print("\n== device ops by hlo_category (achieved rates over span time)")
+        print(f"  {'category':<28}{'ms':>10}{'share':>8}{'TFLOP/s':>9}{'GB/s':>8}")
+        for name, ms, share, tf, gb in dev["categories"]:
+            print(f"  {name:<28}{ms:>10.1f}{share:>8.1%}{tf:>9.1f}{gb:>8.0f}")
+        print("\n== top device ops")
+        for name, ms, n, tf, gb in dev["top_ops"]:
+            print(f"  {name:<42}{ms:>9.1f} ms  n={n:<5}{tf:>7.1f} TF/s{gb:>7.0f} GB/s")
     return 0
 
 
