@@ -669,6 +669,12 @@ def main():
                     help="tower attention core: auto = fused Pallas kernel for "
                          "bf16 self-attention (VMEM-resident at tower seqs, "
                          "blockwise flash beyond), dense = plain XLA einsums")
+    ap.add_argument("--text-attn-impl", default="",
+                    choices=["", "auto", "dense", "flash"],
+                    help="override the TEXT tower's attention impl only (A/B: "
+                         "at s=64 the s2-HBM-traffic rationale for the fused "
+                         "kernel is weakest — the trace shows its backward at "
+                         "5.3 TFLOP/s; empty = follow --attn-impl)")
     ap.add_argument("--scan-layers", action="store_true",
                     help="lax.scan over tower depth instead of the unrolled "
                          "default (O(1) compile time in depth, ~1.3%% slower)")
@@ -774,6 +780,10 @@ def main():
             cfg,
             vision=dataclasses.replace(cfg.vision, attn_impl=args.attn_impl),
             text=dataclasses.replace(cfg.text, attn_impl=args.attn_impl),
+        )
+    if args.text_attn_impl:
+        cfg = dataclasses.replace(
+            cfg, text=dataclasses.replace(cfg.text, attn_impl=args.text_attn_impl)
         )
     if not args.scan_layers:
         # Unrolled block stacks are the measured-fastest config (docs/PERF.md);
@@ -923,6 +933,10 @@ def main():
     # magnitude low; publishing a 0.06 "hw_util" next to a 0.51 MFU would be noise.
     hw_tflops = None
     record["scan_layers"] = args.scan_layers
+    if args.attn_impl != "auto":
+        record["attn_impl"] = args.attn_impl
+    if args.text_attn_impl:
+        record["text_attn_impl"] = args.text_attn_impl
     if args.moe:
         record["moe_experts"] = args.moe
         record["moe_num_selected"] = args.moe_k

@@ -177,13 +177,17 @@ class _DeviceOpAccum:
     def __init__(self):
         self.cat = defaultdict(lambda: [0.0, 0.0, 0.0])  # ms, flops, bytes
         self.ops = defaultdict(lambda: [0.0, 0, 0.0, 0.0])  # ms, n, flops, bytes
+        # Persisted across add() calls: chunked captures may carry the "M"
+        # metadata events only in the first file (same contract as _TrackAccum).
+        self.tid_names: dict = {}
 
     def add(self, events) -> None:
-        tid_names = {
-            (ev.get("pid"), ev.get("tid")): ev.get("args", {}).get("name", "")
-            for ev in events
-            if ev.get("ph") == "M" and ev.get("name") == "thread_name"
-        }
+        tid_names = self.tid_names
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+                tid_names[(ev.get("pid"), ev.get("tid"))] = ev.get(
+                    "args", {}
+                ).get("name", "")
         for ev in events:
             if not (
                 ev.get("ph") == "X"
