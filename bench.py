@@ -90,7 +90,12 @@ def emit_backend_error(args, error: str) -> None:
     with value 0 and the failure cause beats a bare traceback for the driver.
     The metric name matches the mode the invocation asked for, so per-metric
     record streams never log a spurious datapoint for a bench that never ran."""
-    if getattr(args, "context", 0):
+    if getattr(args, "eval_throughput", False):
+        metric, unit = (
+            f"siglip_vit{args.model}_eval_pairs_per_sec_per_chip",
+            "pairs/s/chip",
+        )
+    elif getattr(args, "context", 0):
         metric, unit = f"attn_block_ms_per_layer_s{args.context}", "ms/layer"
     elif getattr(args, "moe_breakdown", False):
         metric, unit = "moe_mlp_fwdbwd_ms", "ms"
@@ -209,6 +214,89 @@ def _timeit_ms(fn, args_, steps: int) -> float:
         out = f(*args_)
     drain(out)
     return (time.perf_counter() - t0) / steps * 1000.0
+
+
+def run_eval_throughput(args) -> int:
+    """Forward-only embedding throughput (the retrieval/zero-shot serving
+    metric): jit of ``model.apply`` producing both towers' embeddings, timed at
+    ``batch`` pairs/call. ``--quant int8`` runs the block projection matmuls in
+    dynamic int8 (ops/quant.py) — the v5e's 394-TOPS int8 MXU gear (2x bf16
+    peak) — so the bf16-vs-int8 pair of runs prices PTQ serving on real
+    hardware. One JSON line; MFU on the 1x-forward FLOPs basis.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+
+    cfg = _base_model_config(args.model)
+    # Inference: no backward, so remat buys nothing; unrolled stacks measured
+    # fastest (docs/PERF.md).
+    tower_kw = dict(remat=False, scan_layers=bool(args.scan_layers))
+    if args.quant:
+        tower_kw["quant"] = args.quant
+    if args.attn_impl != "auto":
+        tower_kw["attn_impl"] = args.attn_impl
+    cfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, **tower_kw),
+        text=dataclasses.replace(cfg.text, **tower_kw),
+    )
+    if args.text_attn_impl:
+        cfg = dataclasses.replace(
+            cfg, text=dataclasses.replace(cfg.text, attn_impl=args.text_attn_impl)
+        )
+    model = SigLIP(cfg)
+    key = jax.random.key(0)
+    images = jax.random.normal(
+        key, (args.batch, cfg.vision.image_size, cfg.vision.image_size, 3),
+        jnp.float32,
+    )
+    tokens = jax.random.randint(
+        key, (args.batch, cfg.text.context_length), 0, cfg.text.vocab_size,
+        jnp.int32,
+    )
+    params = model.init(key, images[:2], tokens[:2])["params"]
+
+    fwd = jax.jit(lambda p, im, tk: model.apply({"params": p}, im, tk)[:2])
+    zi, zt = fwd(params, images, tokens)
+    float(jnp.sum(zi).astype(jnp.float32))  # drain (axon sync caveat)
+    t0 = time.time()
+    for _ in range(args.steps):
+        zi, zt = fwd(params, images, tokens)
+    float(jnp.sum(zi).astype(jnp.float32) + jnp.sum(zt).astype(jnp.float32))
+    dt = time.time() - t0
+
+    pairs_per_sec = args.batch * args.steps / dt
+    device_kind = jax.devices()[0].device_kind
+    fwd_flops = model_forward_flops_per_pair(cfg)
+    tflops = fwd_flops * pairs_per_sec / 1e12
+    peak = PEAK_BF16_TFLOPS.get(device_kind)
+    record = {
+        "metric": f"siglip_vit{args.model}_eval_pairs_per_sec_per_chip",
+        "value": round(pairs_per_sec, 2),
+        "unit": "pairs/s/chip",
+        # Serving has no A100 ballpark in BASELINE.md; the comparison that
+        # matters is bf16-vs-int8 at the same shapes, so vs_baseline pins 1.0.
+        "vs_baseline": 1.0,
+        "model": args.model,
+        "batch": args.batch,
+        "steps": args.steps,
+        "quant": args.quant or "bf16",
+        "scan_layers": bool(args.scan_layers),
+        "device_kind": device_kind,
+        "fwd_tflops_per_sec_per_chip": round(tflops, 1),
+    }
+    if args.attn_impl != "auto":
+        record["attn_impl"] = args.attn_impl
+    if args.text_attn_impl:
+        record["text_attn_impl"] = args.text_attn_impl
+    if peak is not None:
+        record["mfu_bf16_basis"] = round(tflops / peak, 3)
+    print(json.dumps(record))
+    return 0
 
 
 def run_context_bench(args) -> int:
@@ -693,6 +781,13 @@ def main():
                          "stages separately (the factored fns the layer runs, "
                          "models/moe.py) plus the dense-MLP baseline, at the "
                          "headline token count")
+    ap.add_argument("--eval-throughput", action="store_true",
+                    help="forward-only embedding throughput INSTEAD of the "
+                         "train bench (the retrieval/zero-shot serving "
+                         "metric); pair with --quant int8 for the PTQ run")
+    ap.add_argument("--quant", default="", choices=["", "int8"],
+                    help="with --eval-throughput: dynamic int8 projection "
+                         "matmuls (v5e int8 MXU = 2x bf16 peak)")
     ap.add_argument("--context", type=int, default=0, metavar="SEQ",
                     help="long-context attention bench INSTEAD of the train "
                          "bench: time one transformer block fwd+bwd at this "
@@ -704,6 +799,26 @@ def main():
         ap.error(f"--moe must be >= 2 experts (or 0 for dense), got {args.moe}")
     if args.moe_k != 1 and not args.moe:
         ap.error("--moe-k without --moe would be a silent no-op")
+    if args.quant and not args.eval_throughput:
+        ap.error("--quant without --eval-throughput would be a silent no-op "
+                 "(the train bench never quantizes: training through round() "
+                 "has zero gradients)")
+    if args.eval_throughput:
+        # Same anti-silent-no-op rule as --step-breakdown: flags the forward
+        # bench cannot honor are refused, not dropped (a record measuring a
+        # different program than the flags claim poisons comparisons). The
+        # honored set: model/batch/steps, --quant, --attn-impl,
+        # --text-attn-impl, --scan-layers.
+        unsupported = {
+            "--accum": args.accum != 1, "--zero1": args.zero1,
+            "--mu-bf16": args.mu_bf16, "--moe": bool(args.moe),
+            "--no-text-remat": args.no_text_remat,
+            "--steps-per-call": args.steps_per_call != 1,
+            "--use-pallas": args.use_pallas,
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if bad:
+            ap.error(f"--eval-throughput does not support {' '.join(bad)}")
     if args.steps_per_call < 1 or args.steps % args.steps_per_call:
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")
@@ -729,6 +844,8 @@ def main():
         emit_backend_error(args, err)
         return 1
 
+    if args.eval_throughput:
+        return run_eval_throughput(args)
     if args.context:
         return run_context_bench(args)
     if args.moe_breakdown:

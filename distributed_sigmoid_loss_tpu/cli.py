@@ -79,6 +79,16 @@ def _model_config(args):
         )
     elif getattr(args, "moe_group_size", 0):
         raise SystemExit("--moe-group-size without --moe-experts is a no-op")
+    if getattr(args, "quant", ""):
+        # Eval/export-only (make_train_step rejects quantized configs): dynamic
+        # int8 projection matmuls — the v5e's 2x-bf16 inference gear.
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(cfg.vision, quant=args.quant),
+            text=dataclasses.replace(cfg.text, quant=args.quant),
+        )
     return cfg
 
 
@@ -695,6 +705,13 @@ def cmd_export(args) -> int:
     )
     from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
 
+    if args.quant and args.what == "train_step":
+        print(
+            "--quant is inference-only (zero gradients through round); "
+            "use it with --what forward",
+            file=sys.stderr,
+        )
+        return 2
     cfg = _model_config(args)
     if args.loss_family != "sigmoid":
         import dataclasses
@@ -908,6 +925,9 @@ def main(argv=None) -> int:
                          "train); mutually exclusive with --data-dir")
     ev.add_argument("--cpu-devices", type=int, default=0)
     ev.add_argument("--ckpt-dir", default="", help="restore params from this checkpoint")
+    ev.add_argument("--quant", choices=["", "int8"], default="",
+                    help="run the towers' projection matmuls in dynamic int8 "
+                         "(v5e int8 MXU = 2x bf16 peak; inference-only)")
     ev.add_argument("--ema", action="store_true",
                     help="evaluate the checkpoint's EMA weights (train --ema-decay)")
 
@@ -916,6 +936,9 @@ def main(argv=None) -> int:
         help="AOT-export a lowered step to a StableHLO artifact (jax.export)",
     )
     ex.add_argument("out", help="output artifact path")
+    ex.add_argument("--quant", choices=["", "int8"], default="",
+                    help="quantize the towers for --what forward artifacts "
+                         "(int8 projection matmuls; rejected for train_step)")
     ex.add_argument("--what", choices=["train_step", "forward"],
                     default="train_step")
     ex.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"],

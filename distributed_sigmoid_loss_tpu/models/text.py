@@ -43,7 +43,8 @@ class TextTransformer(nn.Module):
             causal=cfg.causal, moe_experts=cfg.moe_experts,
             moe_num_selected=cfg.moe_num_selected,
             moe_capacity_factor=cfg.moe_capacity_factor,
-            moe_group_size=cfg.moe_group_size, name="encoder",
+            moe_group_size=cfg.moe_group_size, quant=(cfg.quant == "int8"),
+            name="encoder",
         )(x)
 
         if cfg.pool == "map":

@@ -29,6 +29,15 @@ def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
 
 
+def _dot_general(quant: bool):
+    """None = flax's default (lax.dot_general); int8 path when quantized."""
+    if not quant:
+        return None
+    from distributed_sigmoid_loss_tpu.ops.quant import int8_dot_general
+
+    return int8_dot_general
+
+
 def _remat_policy(name: str):
     """None = rematerialize everything (jax.checkpoint default)."""
     if name == "nothing":
@@ -58,14 +67,17 @@ class Mlp(nn.Module):
     # to the exact integer.
     mlp_ratio: int | float
     dtype: Any
+    quant: bool = False  # int8 projection matmuls (inference only; ops/quant.py)
 
     @nn.compact
     def __call__(self, x):
         hidden = int(round(self.width * self.mlp_ratio))
+        dg = _dot_general(self.quant)
         # Column-parallel in, row-parallel out: the tp all-reduce happens once, after wo.
         wi = nn.Dense(
             hidden,
             dtype=self.dtype,
+            dot_general=dg,
             kernel_init=nn.with_partitioning(
                 nn.initializers.xavier_uniform(), (None, TP_AXIS)
             ),
@@ -74,6 +86,7 @@ class Mlp(nn.Module):
         wo = nn.Dense(
             self.width,
             dtype=self.dtype,
+            dot_general=dg,
             kernel_init=nn.with_partitioning(
                 nn.initializers.xavier_uniform(), (TP_AXIS, None)
             ),
@@ -103,19 +116,21 @@ class Attention(nn.Module):
     sp_impl: str = "ring"  # "ring" (ppermute) or "ulysses" (all-to-all)
     attn_impl: str = "auto"  # "dense" | "flash" | "auto"
     causal: bool = False
+    quant: bool = False  # int8 projection matmuls (inference only; ops/quant.py)
 
     @nn.compact
     def __call__(self, x_q, x_kv=None):
         is_self_attention = x_kv is None
         x_kv = x_q if x_kv is None else x_kv
         head_dim = self.width // self.num_heads
+        dg = _dot_general(self.quant)
 
         qkv_init = nn.with_partitioning(nn.initializers.xavier_uniform(), (None, TP_AXIS))
         out_init = nn.with_partitioning(nn.initializers.xavier_uniform(), (TP_AXIS, None))
 
-        q = nn.Dense(self.width, dtype=self.dtype, kernel_init=qkv_init, name="q")(x_q)
-        k = nn.Dense(self.width, dtype=self.dtype, kernel_init=qkv_init, name="k")(x_kv)
-        v = nn.Dense(self.width, dtype=self.dtype, kernel_init=qkv_init, name="v")(x_kv)
+        q = nn.Dense(self.width, dtype=self.dtype, dot_general=dg, kernel_init=qkv_init, name="q")(x_q)
+        k = nn.Dense(self.width, dtype=self.dtype, dot_general=dg, kernel_init=qkv_init, name="k")(x_kv)
+        v = nn.Dense(self.width, dtype=self.dtype, dot_general=dg, kernel_init=qkv_init, name="v")(x_kv)
 
         def split(t):
             return t.reshape(t.shape[:-1] + (self.num_heads, head_dim))
@@ -205,7 +220,10 @@ class Attention(nn.Module):
         # forward is never re-run.
         out = checkpoint_name(out, "attn_core")
         out = out.reshape(out.shape[:-2] + (self.width,))
-        return nn.Dense(self.width, dtype=self.dtype, kernel_init=out_init, name="out")(out)
+        return nn.Dense(
+            self.width, dtype=self.dtype, dot_general=dg, kernel_init=out_init,
+            name="out",
+        )(out)
 
 
 class Block(nn.Module):
@@ -226,13 +244,24 @@ class Block(nn.Module):
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
     moe_group_size: int = 512
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
+        if self.quant and self.moe_experts > 0:
+            # The expert einsums (the FLOPs majority of an MoE block) have no
+            # int8 path yet; quantizing only the attention projections would
+            # silently sell bf16 serving as "int8". Refuse until implemented.
+            raise ValueError(
+                "quant='int8' is not supported for MoE towers yet "
+                "(moe_experts > 0): the expert dispatch/MLP einsums would "
+                "silently stay bf16 — serve MoE unquantized"
+            )
         x = x + Attention(
             self.width, self.num_heads, self.dtype,
             sp_axis=self.sp_axis, sp_impl=self.sp_impl,
             attn_impl=self.attn_impl, causal=self.causal,
+            quant=self.quant,
             name="attn",
         )(nn.LayerNorm(dtype=self.dtype, name="ln1")(x))
         if self.moe_experts > 0:
@@ -246,7 +275,10 @@ class Block(nn.Module):
                 name="moe",
             )
         else:
-            mlp = Mlp(self.width, self.mlp_ratio, self.dtype, name="mlp")
+            mlp = Mlp(
+                self.width, self.mlp_ratio, self.dtype, quant=self.quant,
+                name="mlp",
+            )
         x = x + mlp(nn.LayerNorm(dtype=self.dtype, name="ln2")(x))
         return x
 
@@ -266,6 +298,7 @@ class _ScanBody(nn.Module):
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
     moe_group_size: int = 512
+    quant: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
@@ -277,6 +310,7 @@ class _ScanBody(nn.Module):
             moe_num_selected=self.moe_num_selected,
             moe_capacity_factor=self.moe_capacity_factor,
             moe_group_size=self.moe_group_size,
+            quant=self.quant,
             name="block",
         )(carry)
         return carry, None
@@ -304,6 +338,7 @@ class Encoder(nn.Module):
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
     moe_group_size: int = 512
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -312,6 +347,7 @@ class Encoder(nn.Module):
             moe_num_selected=self.moe_num_selected,
             moe_capacity_factor=self.moe_capacity_factor,
             moe_group_size=self.moe_group_size,
+            quant=self.quant,
         )
         if self.scan_layers:
             body_cls = _ScanBody

@@ -91,7 +91,8 @@ class ViT(nn.Module):
             moe_experts=cfg.moe_experts,
             moe_num_selected=cfg.moe_num_selected,
             moe_capacity_factor=cfg.moe_capacity_factor,
-            moe_group_size=cfg.moe_group_size, name="encoder",
+            moe_group_size=cfg.moe_group_size, quant=(cfg.quant == "int8"),
+            name="encoder",
         )(x)
 
         if cfg.pool == "map":

@@ -1,0 +1,95 @@
+"""Dynamic int8 quantized matmul for inference — the v5e's second MXU gear.
+
+TPU v5e executes int8×int8→int32 ``dot_general`` at 394 TOPS, exactly 2× the
+bf16 peak (public spec sheet), and XLA lowers integer dots to the MXU
+directly. For inference (eval/retrieval/zero-shot serving, ``train`` is NOT
+the audience — see below) the towers can run their projection matmuls in int8
+with dynamic symmetric quantization:
+
+- **activations**: per-row abs-max over the contraction axis, computed on the
+  fly (no calibration pass, no stored stats);
+- **weights**: per-output-channel abs-max over the contraction axis.
+
+Per-channel weight scales + per-row dynamic activation scales is the standard
+PTQ recipe that keeps ViT/text-transformer quality (~1e-3 relative error per
+matmul; the model-level contract is pinned in tests/test_quant.py).
+
+The integration point is flax's ``nn.Dense(dot_general=...)`` injection —
+the param tree is untouched, so ANY trained/imported checkpoint can be served
+quantized by flipping ``quant="int8"`` on the tower config (utils/config.py).
+
+NOT for training: ``round`` has zero gradient almost everywhere, so a
+quantized tower trains to a standstill silently. The config guard in the
+towers rejects quant + trainable contexts; there is no straight-through
+estimator here (add one if QAT ever becomes a target).
+
+No reference analogue (the reference has no model/serving layer; SURVEY.md
+§2 C8 documents docs-only coverage there) — this is TPU-first scope beyond it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["int8_dot_general", "quantize_int8"]
+
+# Symmetric int8: round-to-nearest into [-127, 127] (−128 unused, keeping the
+# scale symmetric so dequant is one multiply).
+_QMAX = 127.0
+# Abs-max floor: an all-zero row/channel would otherwise divide by zero; any
+# value below this quantizes to exact zeros with a harmless scale.
+_EPS = 1e-12
+
+
+def quantize_int8(x: jnp.ndarray, axis: int):
+    """Symmetric int8 quantization of ``x`` along ``axis``.
+
+    Returns ``(q, scale)`` with ``q`` int8, ``scale`` float32 keeping ``axis``
+    as a size-1 dim, such that ``q * scale ≈ x``.
+    """
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True), _EPS
+    ) / _QMAX
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -_QMAX, _QMAX).astype(
+        jnp.int8
+    )
+    return q, scale
+
+
+def int8_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                     preferred_element_type=None):
+    """Drop-in ``lax.dot_general`` that runs the contraction in int8.
+
+    Specialized to the single-contraction, no-batch-dims pattern every
+    ``nn.Dense`` emits; anything else falls through to the real
+    ``lax.dot_general`` unquantized (correct, just not accelerated).
+    ``precision``/``preferred_element_type`` are accepted for signature
+    compatibility; the int8 path fixes accumulation to int32 (the MXU's
+    native accumulator — there is nothing to configure).
+    """
+    (lc, rc), (lb, rb) = dimension_numbers
+    if lb or rb or len(lc) != 1 or len(rc) != 1:
+        return lax.dot_general(
+            lhs, rhs, dimension_numbers, precision=precision,
+            preferred_element_type=preferred_element_type,
+        )
+    # Same output-dtype rule as lax.dot_general, so both branches of this
+    # function (and a swap back to the real dot) are drop-in interchangeable.
+    out_dtype = (
+        preferred_element_type
+        if preferred_element_type is not None
+        else jnp.promote_types(lhs.dtype, rhs.dtype)
+    )
+    lq, ls = quantize_int8(lhs, lc[0])   # activations: per-row over K
+    rq, rs = quantize_int8(rhs, rc[0])   # weights: per-out-channel over K
+    acc = lax.dot_general(
+        lq, rq, dimension_numbers, preferred_element_type=jnp.int32
+    )
+    # Result dims = lhs-free then rhs-free: lhs scales broadcast from the
+    # left (padded with one 1 per rhs-free dim), rhs scales from the right.
+    ls_free = jnp.squeeze(ls, axis=lc[0])
+    rs_free = jnp.squeeze(rs, axis=rc[0])
+    n_rhs_free = rhs.ndim - 1
+    ls_b = ls_free.reshape(ls_free.shape + (1,) * n_rhs_free)
+    return (acc.astype(jnp.float32) * ls_b * rs_free).astype(out_dtype)

@@ -327,6 +327,16 @@ def make_train_step(
     stays dp-sharded) and with ``accum_steps`` (each accumulation microbatch is
     itself pipelined); dense towers only.
     """
+    cfg = getattr(model, "cfg", None)
+    for tower in ("vision", "text"):
+        if getattr(getattr(cfg, tower, None), "quant", ""):
+            # round() in the int8 path has zero gradient a.e. — training a
+            # quantized tower silently goes nowhere. Quant is eval/export-only.
+            raise ValueError(
+                f"{tower} tower has quant={getattr(cfg, tower).quant!r}: int8 "
+                "quantization is inference-only (zero gradients through "
+                "round); train with quant='' and quantize at eval/export time"
+            )
     axis = loss_cfg.axis_name
     precision = _precision(loss_cfg.precision)
     # The model's `bias` param plays no role under family="softmax" (zero

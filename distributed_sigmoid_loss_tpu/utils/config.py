@@ -71,6 +71,10 @@ class ViTConfig:
     # Routing group size (GShard groups): capacity is per-group, keeping the
     # dispatch tensors O(tokens*E*C_group); tune down for tight HBM budgets.
     moe_group_size: int = 512
+    # "int8": run the block projection matmuls (q/k/v/out/wi/wo) in dynamic
+    # symmetric int8 — v5e int8 MXU peak is 2x bf16. INFERENCE ONLY (round()
+    # kills gradients); make_train_step rejects quantized configs.
+    quant: Literal["", "int8"] = ""
 
     @classmethod
     def vit_b16(cls, **kw) -> "ViTConfig":
@@ -119,6 +123,10 @@ class TextConfig:
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
     moe_group_size: int = 512
+    # "int8": run the block projection matmuls (q/k/v/out/wi/wo) in dynamic
+    # symmetric int8 — v5e int8 MXU peak is 2x bf16. INFERENCE ONLY (round()
+    # kills gradients); make_train_step rejects quantized configs.
+    quant: Literal["", "int8"] = ""
 
     @classmethod
     def base(cls, **kw) -> "TextConfig":
