@@ -263,11 +263,11 @@ def run_eval_throughput(args) -> int:
     fwd = jax.jit(lambda p, im, tk: model.apply({"params": p}, im, tk)[:2])
     zi, zt = fwd(params, images, tokens)
     float(jnp.sum(zi).astype(jnp.float32))  # drain (axon sync caveat)
-    t0 = time.time()
+    t0 = time.perf_counter()
     for _ in range(args.steps):
         zi, zt = fwd(params, images, tokens)
     float(jnp.sum(zi).astype(jnp.float32) + jnp.sum(zt).astype(jnp.float32))
-    dt = time.time() - t0
+    dt = time.perf_counter() - t0
 
     pairs_per_sec = args.batch * args.steps / dt
     device_kind = jax.devices()[0].device_kind
@@ -803,6 +803,15 @@ def main():
         ap.error("--quant without --eval-throughput would be a silent no-op "
                  "(the train bench never quantizes: training through round() "
                  "has zero gradients)")
+    modes = {
+        "--eval-throughput": args.eval_throughput,
+        "--context": bool(args.context),
+        "--moe-breakdown": args.moe_breakdown,
+        "--step-breakdown": args.step_breakdown,
+    }
+    picked_modes = [k for k, v in modes.items() if v]
+    if len(picked_modes) > 1:
+        ap.error(f"{' '.join(picked_modes)} are mutually exclusive bench modes")
     if args.eval_throughput:
         # Same anti-silent-no-op rule as --step-breakdown: flags the forward
         # bench cannot honor are refused, not dropped (a record measuring a
@@ -815,10 +824,14 @@ def main():
             "--no-text-remat": args.no_text_remat,
             "--steps-per-call": args.steps_per_call != 1,
             "--use-pallas": args.use_pallas,
+            "--variant": args.variant != "ring",
+            "--loss-family": args.loss_family != "sigmoid",
+            "--precision": args.precision != "default",
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:
-            ap.error(f"--eval-throughput does not support {' '.join(bad)}")
+            ap.error(f"--eval-throughput does not support {' '.join(bad)} "
+                     "(forward-only: no loss, no optimizer)")
     if args.steps_per_call < 1 or args.steps % args.steps_per_call:
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")

@@ -6,6 +6,7 @@ from distributed_sigmoid_loss_tpu.train.train_step import (  # noqa: F401
     zero1_constrain,
 )
 from distributed_sigmoid_loss_tpu.train.checkpoint import (  # noqa: F401
+    AsyncSaver,
     save_checkpoint,
     restore_checkpoint,
 )

@@ -157,10 +157,18 @@ def latest_step(root: str) -> int | None:
     return max(steps) if steps else None
 
 
-def save_step(root: str, step: int, state: Any) -> str:
-    """Save ``state`` as checkpoint ``step`` under ``root``; returns the path."""
+def save_step(root: str, step: int, state: Any, saver=None) -> str:
+    """Save ``state`` as checkpoint ``step`` under ``root``; returns the path.
+
+    ``saver`` (a ``checkpoint.AsyncSaver``) makes the write non-blocking — the
+    caller owns its lifetime and must ``wait()`` before trusting
+    ``latest_step`` on the same root.
+    """
     path = _step_dir(root, step)
-    save_checkpoint(path, state)
+    if saver is not None:
+        saver.save(path, state)
+    else:
+        save_checkpoint(path, state)
     return path
 
 
@@ -204,6 +212,7 @@ def train_resilient(
     on_metrics: Callable[[int, dict], None] | None = None,
     check_finite_every: int = 1,
     require_restore: bool = False,
+    saver=None,
 ) -> tuple[Any, ResilienceReport]:
     """Run ``step_fn`` to ``total_steps`` with checkpoint/resume, preemption
     checkpointing, and divergence detection.
@@ -232,6 +241,12 @@ def train_resilient(
     restore target (``create_train_state(zeros=True)``) — training from it
     would silently proceed from all-zero params and then overwrite
     ``ckpt_dir`` with garbage checkpoints.
+
+    ``saver`` (a ``checkpoint.AsyncSaver``): checkpoint writes overlap the
+    following train steps instead of stalling the loop (~seconds per save at
+    so400m scale). The loop ``wait()``s before any rollback restore (the
+    newest checkpoint must be durable to be restorable) and before returning,
+    so the report's ``checkpoints`` are always durable by exit.
     """
     report = ResilienceReport()
     resumed = restore_latest(ckpt_dir, state)
@@ -254,7 +269,7 @@ def train_resilient(
             # Orbax saves the (possibly multi-host, sharded) global arrays
             # directly — no device_get, which would fail on non-addressable
             # shards and waste a host copy on single-host.
-            save_step(ckpt_dir, s, st)
+            save_step(ckpt_dir, s, st, saver=saver)
             report.checkpoints.append(s)
             last_good = s
 
@@ -271,6 +286,9 @@ def train_resilient(
         check_now = (step + 1) % max(1, check_finite_every) == 0
         if check_now and not np.isfinite(loss := float(metrics["loss"])):
             report.divergences += 1
+            if saver is not None:
+                # The newest (rollback target) checkpoint may still be writing.
+                saver.wait()
             restored = restore_latest(ckpt_dir, state)
             restored_state, restored_step = (None, None)
             if restored is not None:
@@ -297,4 +315,6 @@ def train_resilient(
             break
 
     report.final_step = step
+    if saver is not None:
+        saver.wait()  # report.checkpoints are durable from here
     return state, report

@@ -231,3 +231,65 @@ def test_require_restore_accepts_existing_checkpoint(tmp_path):
         ckpt_dir=str(tmp_path), require_restore=True,
     )
     assert report.start_step == 2
+
+
+# -- async checkpointing (checkpoint.AsyncSaver) --------------------------------
+
+
+def test_async_saver_roundtrip(tmp_path):
+    from distributed_sigmoid_loss_tpu.train import AsyncSaver
+    from distributed_sigmoid_loss_tpu.train.checkpoint import restore_checkpoint
+
+    _, state = _make_step()
+    path = str(tmp_path / "async_ck")
+    with AsyncSaver() as saver:
+        saver.save(path, state)
+        saver.wait()  # durable before restore
+        restored = restore_checkpoint(path, state)
+    for a, b in zip(_leaves(state), _leaves(restored)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_async_train_resilient_matches_sync(tmp_path):
+    """Same checkpoints, same final state, whether saves block or overlap."""
+    from distributed_sigmoid_loss_tpu.train import AsyncSaver
+
+    step_fn, init_state = _make_step()
+    batches = _batches(8)
+
+    sync_state, sync_report = train_resilient(
+        init_state, step_fn, batches, total_steps=8,
+        ckpt_dir=str(tmp_path / "sync"), ckpt_every=3,
+    )
+    with AsyncSaver() as saver:
+        async_state, async_report = train_resilient(
+            init_state, step_fn, batches, total_steps=8,
+            ckpt_dir=str(tmp_path / "async"), ckpt_every=3, saver=saver,
+        )
+        # train_resilient waits before returning: durable WITHOUT leaving the
+        # context first.
+        assert latest_step(str(tmp_path / "async")) == 8
+    assert async_report.checkpoints == sync_report.checkpoints == [3, 6, 8]
+    for a, b in zip(_leaves(sync_state), _leaves(async_state)):
+        np.testing.assert_array_equal(a, b)
+    # And the async run's newest checkpoint restores to the same state.
+    restored, step = restore_latest(str(tmp_path / "async"), init_state)
+    assert step == 8
+    for a, b in zip(_leaves(restored), _leaves(async_state)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_async_divergence_rollback_waits_for_inflight_save(tmp_path):
+    """The rollback restore must see the newest checkpoint even if its write
+    was still in flight when the divergence hit."""
+    from distributed_sigmoid_loss_tpu.train import AsyncSaver
+
+    step_fn, init_state = _make_step()
+    batches = _batches(8, poison_at=5)  # diverges right after the step-4 save
+    with AsyncSaver() as saver:
+        with pytest.raises(TrainingDiverged) as ei:
+            train_resilient(
+                init_state, step_fn, batches, total_steps=8,
+                ckpt_dir=str(tmp_path / "ck"), ckpt_every=4, saver=saver,
+            )
+    assert ei.value.restored_step == 4
