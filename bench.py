@@ -721,6 +721,11 @@ def main():
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation microsteps (scan over microbatches); "
                          "batch is the TOTAL per-chip pairs per optimizer step")
+    ap.add_argument("--accum-negatives", default="local",
+                    choices=["local", "global"],
+                    help="with --accum: 'global' prices the GradCache-style "
+                         "exact-full-negatives accumulation (extra embed pass "
+                         "per microbatch) vs plain 'local'")
     ap.add_argument("--variant", default="ring", choices=["ring", "all_gather"])
     ap.add_argument("--loss-family", default="sigmoid",
                     choices=["sigmoid", "softmax"],
@@ -827,6 +832,7 @@ def main():
             "--variant": args.variant != "ring",
             "--loss-family": args.loss_family != "sigmoid",
             "--precision": args.precision != "default",
+            "--accum-negatives": args.accum_negatives != "local",
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:
@@ -845,6 +851,7 @@ def main():
             "--accum": args.accum != 1, "--zero1": args.zero1,
             "--moe": bool(args.moe), "--no-text-remat": args.no_text_remat,
             "--steps-per-call": args.steps_per_call != 1,
+            "--accum-negatives": args.accum_negatives != "local",
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:
@@ -962,6 +969,7 @@ def main():
     step, shardings = make_train_step(
         model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1,
         moe_aux_weight=0.01 if args.moe else None,
+        accum_negatives=args.accum_negatives,
     )
     batch = jax.device_put(batch, shardings)
 
@@ -1045,6 +1053,7 @@ def main():
         "per_chip_batch": args.batch,
         "global_batch": global_b,
         "accum_steps": args.accum,
+        "accum_negatives": args.accum_negatives,
         "steps": args.steps,
         "steps_per_call": spc,
         "variant": args.variant,

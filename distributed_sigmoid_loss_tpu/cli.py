@@ -165,6 +165,10 @@ def cmd_train(args) -> int:
     _bootstrap_devices(args)
     import jax
 
+    if args.async_checkpoint and not args.ckpt_dir:
+        print("--async-checkpoint without --ckpt-dir would be a silent no-op "
+              "(there is nothing to save)", file=sys.stderr)
+        return 2
     if args.coordinator:
         if args.num_processes < 1 or args.process_id < 0:
             print(
@@ -419,6 +423,7 @@ def cmd_train(args) -> int:
         LossConfig(variant=args.variant, family=args.loss_family,
                    precision="default"),
         accum_steps=args.accum,
+        accum_negatives=args.accum_negatives,
         zero1=args.zero1,
         ema_decay=args.ema_decay,
         moe_aux_weight=(
@@ -850,6 +855,14 @@ def main(argv=None) -> int:
     tr.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"], default="b16")
     tr.add_argument("--tiny", action="store_true", help="alias for --model tiny")
     tr.add_argument("--accum", type=int, default=1, help="grad-accumulation microsteps")
+    tr.add_argument("--accum-negatives", choices=["local", "global"],
+                    default="local",
+                    help="with --accum > 1: 'local' contrasts each microbatch "
+                         "against its own texts only (cheap, smaller negative "
+                         "set); 'global' computes the EXACT full-batch loss "
+                         "GradCache-style (embed pass + loss island + "
+                         "surrogate re-forward; ~30%% slower, bitwise-faithful "
+                         "negatives)")
     tr.add_argument("--moe-experts", type=int, default=0,
                     help="swap tower MLPs for this many experts per block "
                          "(mixture-of-experts; shards over an ep mesh axis)")

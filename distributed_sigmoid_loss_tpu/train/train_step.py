@@ -108,6 +108,26 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(optax.clip_by_global_norm(1.0), opt)
 
 
+def _mean_moe_aux(variables) -> jax.Array:
+    """Mean over every sown router aux scalar (scanned encoders sow one
+    (depth,) leaf per tower; unrolled ones sow per-layer scalars). Filter by
+    the sow name so other intermediates never leak into the objective."""
+    flat = jax.tree_util.tree_flatten_with_path(
+        variables.get("intermediates", {})
+    )[0]
+    leaves = [
+        leaf
+        for path, leaf in flat
+        if any(getattr(k, "key", None) == "moe_aux_loss" for k in path)
+    ]
+    if not leaves:
+        raise ValueError(
+            "moe_aux_weight is set but the model sowed no moe_aux_loss — "
+            "enable moe_experts on the tower configs"
+        )
+    return sum(jnp.sum(leaf) for leaf in leaves) / sum(leaf.size for leaf in leaves)
+
+
 def _precision(name: str):
     return {"highest": lax.Precision.HIGHEST, "default": lax.Precision.DEFAULT}[name]
 
@@ -295,6 +315,7 @@ def make_train_step(
     ema_decay: float | None = None,
     moe_aux_weight: float | None = None,
     pp_microbatches: int = 0,
+    accum_negatives: str = "local",
 ):
     """Build the jitted ``(state, batch) -> (state, metrics)`` step.
 
@@ -306,7 +327,19 @@ def make_train_step(
     reach e.g. the 32k-global north star on fewer chips. Contrastive caveat
     (inherent to accumulation, same as open_clip without its re-encoding trick):
     each microbatch contrasts only against its own texts, so the negative set per
-    loss term is ``global/accum_steps``, not ``global``.
+    loss term is ``global/accum_steps``, not ``global`` — UNLESS
+    ``accum_negatives="global"`` (below).
+
+    ``accum_negatives="global"`` (with ``accum_steps > 1``) computes the EXACT
+    full-batch loss under accumulation, GradCache-style (Gao et al. 2021;
+    open_clip's re-encoding trick): pass 1 scans the microbatches for
+    embeddings only (no activation storage beyond one microbatch); the loss +
+    its embedding gradients are computed ONCE on the full (global_b, d)
+    embedding tables (tiny: 32k x 512 f32 = 67 MB); pass 2 re-scans with the
+    surrogate objective ``<z_m, stop_grad(dL/dz_m)>`` whose parameter gradient
+    is exactly the full-batch term. Grad oracle: identical (rtol 1e-5) to the
+    unaccumulated big-batch step — the property "local" loses. Cost: one extra
+    forward per microbatch (~30% step time at save_hot remat ratios).
 
     ``zero1=True`` keeps the optimizer state sharded over ``dp`` (ZeRO-1, see
     :func:`zero1_constrain`); create the state with the same flag.
@@ -364,6 +397,18 @@ def make_train_step(
         check_vma=not loss_cfg.use_pallas,
     )
 
+    if accum_negatives not in ("local", "global"):
+        raise ValueError(
+            f"accum_negatives must be 'local' or 'global', got {accum_negatives!r}"
+        )
+    # accum_steps == 1 with "global" is not an error — an unaccumulated step
+    # already contrasts globally — it just takes the plain path.
+    cached_accum = accum_negatives == "global" and accum_steps > 1
+    if cached_accum and pp_microbatches:
+        raise ValueError(
+            "accum_negatives='global' with pp_microbatches is not supported "
+            "(the pp forward is already whole-batch per accumulation step)"
+        )
     if pp_microbatches < 0:
         raise ValueError(f"pp_microbatches must be >= 0, got {pp_microbatches}")
     if pp_microbatches:
@@ -412,32 +457,108 @@ def make_train_step(
                 {"params": params}, batch["images"], batch["tokens"],
                 mutable=["intermediates"],
             )
-            # Mean over every sown router aux scalar (scanned encoders sow one
-            # (depth,) leaf per tower; unrolled ones sow per-layer scalars).
-            # Filter by the sow name so other intermediates never leak into the
-            # objective.
-            flat = jax.tree_util.tree_flatten_with_path(
-                variables.get("intermediates", {})
-            )[0]
-            leaves = [
-                leaf
-                for path, leaf in flat
-                if any(
-                    getattr(k, "key", None) == "moe_aux_loss" for k in path
-                )
-            ]
-            if not leaves:
-                raise ValueError(
-                    "moe_aux_weight is set but the model sowed no moe_aux_loss — "
-                    "enable moe_experts on the tower configs"
-                )
-            aux = sum(jnp.sum(l) for l in leaves) / sum(l.size for l in leaves)
+            aux = _mean_moe_aux(variables)
         loss = sharded_loss(zimg, ztxt, lp["t_prime"], lp["bias"])
         if moe_aux_weight is not None:
             loss = loss + moe_aux_weight * aux
         return loss, (lp, aux)
 
+    # accum_negatives="global": the stacked-embedding loss island. Each device
+    # sees its LOCAL rows of every microbatch (M, mb/dp, d) and flattens them
+    # locally (free reshape) — the per-shard loss + ring/all-gather machinery
+    # then contrasts every image against every text GLOBALLY, exactly as the
+    # unaccumulated step would. Pair alignment holds because zimg/ztxt are
+    # stacked by the same microbatch split, and the pair-set sum is
+    # permutation-invariant.
+    def stacked_shard_loss(zis, zts, t_prime, bias):
+        m, mb_local, d = zis.shape
+        return lax.pmean(
+            per_shard(
+                zis.reshape(m * mb_local, d), zts.reshape(m * mb_local, d),
+                t_prime, bias,
+            ),
+            axis,
+        )
+
+    stacked_loss = jax.shard_map(
+        stacked_shard_loss,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(), P()),
+        out_specs=P(),
+        check_vma=not loss_cfg.use_pallas,
+    )
+
+    def grads_and_metrics_cached(params, batch):
+        from distributed_sigmoid_loss_tpu.parallel.microbatch import (
+            microbatch_split,
+        )
+
+        micro = jax.tree.map(
+            lambda x: microbatch_split(x, accum_steps, mesh, axis, what="accum_steps"),
+            batch,
+        )
+
+        # Pass 1: embeddings only. No gradients, so XLA keeps one microbatch
+        # of activations live at a time; Z is (M, mb, d) f32 — megabytes.
+        def embed(_, mb):
+            zi, zt, lp_ = model.apply(
+                {"params": params}, mb["images"], mb["tokens"]
+            )
+            return None, (zi, zt, lp_)
+
+        _, (zis, zts, lps) = lax.scan(embed, None, micro)
+        lp = jax.tree.map(lambda x: x[-1], lps)
+
+        # Loss island ONCE on the full tables: loss value + dL/dZ + the direct
+        # t_prime/bias gradients.
+        (loss, island_grads) = jax.value_and_grad(
+            lambda zi, zt, tp, b: stacked_loss(zi, zt, tp, b), argnums=(0, 1, 2, 3)
+        )(zis, zts, lp["t_prime"], lp["bias"])
+        g_zis, g_zts, g_tp, g_bias = jax.tree.map(lax.stop_gradient, island_grads)
+
+        # Pass 2: per-microbatch VJP via the surrogate <z_m, g_m> (+ the direct
+        # loss-param terms and the MoE aux, each 1/M per microbatch so their
+        # totals land once). d(surrogate)/dparams sums to the EXACT full-batch
+        # gradient — no /M on the z terms (dL/dZ already carries the scale).
+        def surrogate(p, mb, g_zi, g_zt):
+            if moe_aux_weight is None:
+                zi, zt, lp_ = model.apply(
+                    {"params": p}, mb["images"], mb["tokens"]
+                )
+                aux_ = jnp.zeros(())
+            else:
+                (zi, zt, lp_), variables = model.apply(
+                    {"params": p}, mb["images"], mb["tokens"],
+                    mutable=["intermediates"],
+                )
+                aux_ = _mean_moe_aux(variables)
+            s = jnp.vdot(zi, g_zi) + jnp.vdot(zt, g_zt)
+            s = s + (
+                jnp.vdot(lp_["t_prime"], g_tp) + jnp.vdot(lp_["bias"], g_bias)
+            ) / accum_steps
+            if moe_aux_weight is not None:
+                s = s + moe_aux_weight * aux_ / accum_steps
+            return s, aux_
+
+        def body(grad_sum, scanned):
+            mb, g_zi, g_zt = scanned
+            (_, aux_), g = jax.value_and_grad(surrogate, has_aux=True)(
+                params, mb, g_zi, g_zt
+            )
+            return jax.tree.map(jnp.add, grad_sum, g), aux_
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        grads, auxs = lax.scan(body, zeros, (micro, g_zis, g_zts))
+        mean_aux = jnp.mean(auxs)
+        if moe_aux_weight is not None:
+            # The optimized objective includes the aux term; report the same
+            # loss the other paths do (metrics, divergence check, A/B curves).
+            loss = loss + moe_aux_weight * mean_aux
+        return loss, lp, mean_aux, grads
+
     def grads_and_metrics(params, batch):
+        if cached_accum:
+            return grads_and_metrics_cached(params, batch)
         if accum_steps == 1:
             (loss, (lp, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch

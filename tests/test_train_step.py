@@ -273,3 +273,73 @@ def test_zeros_train_state_matches_real_structure():
         restored = restore_checkpoint(f"{d}/ck", zero)
     for a, b in zip(jax.tree.leaves(real), jax.tree.leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("variant", ["ring", "all_gather"])
+def test_cached_accumulation_matches_big_batch_exactly(variant):
+    """THE GradCache oracle: accum_negatives='global' must reproduce the
+    UNACCUMULATED big-batch update (full negative set), which plain 'local'
+    accumulation cannot — each of its microbatches only sees its own negatives.
+    sgd(1.0) makes the updated params literally the gradients."""
+    import optax
+
+    cfg = SigLIPConfig.tiny_test()
+    mesh = make_mesh(2)
+    model = SigLIP(cfg)
+    tx = optax.sgd(1.0)
+    B, accum = 8, 2
+    batch = tiny_batch(B, cfg)
+
+    def run(**kw):
+        state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+        step, shardings = make_train_step(
+            model, mesh, LossConfig(variant=variant), **kw
+        )
+        state, metrics = step(state, jax.device_put(batch, shardings))
+        return state.params, float(metrics["loss"])
+
+    big_params, big_loss = run()
+    cached_params, cached_loss = run(accum_steps=accum, accum_negatives="global")
+    local_params, local_loss = run(accum_steps=accum)
+
+    # Cached == big batch: same loss, same update.
+    assert abs(cached_loss - big_loss) / abs(big_loss) < 1e-5
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(big_params)[0],
+        jax.tree_util.tree_flatten_with_path(cached_params)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-4, atol=2e-6, err_msg=jax.tree_util.keystr(pa),
+        )
+
+    # And the property is non-trivial: local accumulation does NOT match the
+    # big-batch update (different negative sets).
+    diffs = [
+        np.max(np.abs(np.asarray(a, np.float32) - np.asarray(b, np.float32)))
+        for a, b in zip(jax.tree.leaves(big_params), jax.tree.leaves(local_params))
+    ]
+    assert max(diffs) > 1e-4, "local accum unexpectedly matched the big batch"
+
+
+def test_cached_accumulation_single_microbatch_is_plain_step():
+    """accum_negatives='global' with accum_steps=1 is just the normal step."""
+    cfg = SigLIPConfig.tiny_test()
+    mesh = make_mesh(2)
+    model = SigLIP(cfg)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=100))
+    batch = tiny_batch(4, cfg)
+    state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+    step, shardings = make_train_step(
+        model, mesh, LossConfig(), accum_negatives="global"
+    )
+    state, metrics = step(state, jax.device_put(batch, shardings))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_cached_accumulation_validates_inputs():
+    cfg = SigLIPConfig.tiny_test()
+    mesh = make_mesh(2)
+    model = SigLIP(cfg)
+    with pytest.raises(ValueError, match="accum_negatives"):
+        make_train_step(model, mesh, LossConfig(), accum_negatives="bogus")
