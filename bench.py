@@ -239,6 +239,11 @@ def run_eval_throughput(args) -> int:
         tower_kw["quant"] = args.quant
     if args.attn_impl != "auto":
         tower_kw["attn_impl"] = args.attn_impl
+    if args.moe:
+        tower_kw["moe_experts"] = args.moe
+        tower_kw["moe_num_selected"] = args.moe_k
+        if args.moe_group_size:
+            tower_kw["moe_group_size"] = args.moe_group_size
     cfg = dataclasses.replace(
         cfg,
         vision=dataclasses.replace(cfg.vision, **tower_kw),
@@ -293,6 +298,11 @@ def run_eval_throughput(args) -> int:
         record["attn_impl"] = args.attn_impl
     if args.text_attn_impl:
         record["text_attn_impl"] = args.text_attn_impl
+    if args.moe:
+        record["moe_experts"] = args.moe
+        record["moe_num_selected"] = args.moe_k
+        if args.moe_group_size:
+            record["moe_group_size"] = args.moe_group_size
     if peak is not None:
         record["mfu_bf16_basis"] = round(tflops / peak, 3)
     print(json.dumps(record))
@@ -822,10 +832,10 @@ def main():
         # bench cannot honor are refused, not dropped (a record measuring a
         # different program than the flags claim poisons comparisons). The
         # honored set: model/batch/steps, --quant, --attn-impl,
-        # --text-attn-impl, --scan-layers.
+        # --text-attn-impl, --scan-layers, --moe/--moe-k/--moe-group-size.
         unsupported = {
             "--accum": args.accum != 1, "--zero1": args.zero1,
-            "--mu-bf16": args.mu_bf16, "--moe": bool(args.moe),
+            "--mu-bf16": args.mu_bf16,
             "--no-text-remat": args.no_text_remat,
             "--steps-per-call": args.steps_per_call != 1,
             "--use-pallas": args.use_pallas,

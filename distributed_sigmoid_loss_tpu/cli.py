@@ -247,6 +247,13 @@ def cmd_train(args) -> int:
         print(f"--pp-microbatches must be >= 1, got {args.pp_microbatches}",
               file=sys.stderr)
         return 2
+    if args.pp > 1 and args.accum > 1 and args.accum_negatives == "global":
+        # Same check exists in make_train_step; repeat it HERE so the exit-2
+        # message lands before the minutes-long create_train_state.
+        print("--accum-negatives global with --pp is not supported (the pp "
+              "forward is already whole-batch per accumulation step)",
+              file=sys.stderr)
+        return 2
     mesh, mesh_err = _make_training_mesh(args)
     if mesh_err:
         print(mesh_err, file=sys.stderr)

@@ -102,11 +102,30 @@ def build_dispatch(gates: jax.Array, idx: jax.Array, e: int, capacity: int):
     return dispatch, combine
 
 
-def expert_apply(xg, dispatch, combine, wi, wo, dtype):
-    """Dispatch-einsum → per-expert MLP → combine-einsum (model dtype)."""
+def expert_apply(xg, dispatch, combine, wi, wo, dtype, quant=False):
+    """Dispatch-einsum → per-expert MLP → combine-einsum (model dtype).
+
+    ``quant=True`` runs the two expert MLP matmuls in dynamic int8
+    (ops/quant.py int8_expert_matmul — inference only, like the dense towers'
+    quant flag); dispatch/combine stay in the model dtype (one-hot routing,
+    <20% of layer FLOPs).
+    """
     expert_in = jnp.einsum(
         "ntec,ntd->encd", dispatch.astype(dtype), xg.astype(dtype)
     )
+    if quant:
+        from distributed_sigmoid_loss_tpu.ops.quant import int8_expert_matmul
+
+        # Same checkpoint tag as the dense path (moot at inference, but the
+        # remat policies stay total over block variants).
+        hidden_act = checkpoint_name(
+            int8_expert_matmul(expert_in, wi, dtype), "mlp_hidden"
+        )
+        h = nn.gelu(hidden_act, approximate=True)
+        return jnp.einsum(
+            "ntec,encd->ntd", combine.astype(dtype),
+            int8_expert_matmul(h, wo, dtype),
+        )
     # Same checkpoint tag as the dense Mlp (transformer.py): the save_hot /
     # save_mlp remat policies keep the expert hidden activation, so backward
     # recompute stops at the elementwise gelu for MoE blocks too.
@@ -145,6 +164,7 @@ class MoeMlp(nn.Module):
     # bench scale (50k tokens/step) single-group routing OOMs 16G HBM. The
     # actual group is the largest divisor of the token count ≤ this target.
     group_size: int = 512
+    quant: bool = False  # int8 expert MLP matmuls (inference only)
 
     @nn.compact
     def __call__(self, x):
@@ -203,5 +223,7 @@ class MoeMlp(nn.Module):
             (e, hidden, d),
             jnp.float32,
         )
-        y = expert_apply(xg, dispatch, combine, wi, wo, self.dtype)
+        y = expert_apply(
+            xg, dispatch, combine, wi, wo, self.dtype, quant=self.quant
+        )
         return y.reshape(*lead, d)

@@ -248,15 +248,6 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        if self.quant and self.moe_experts > 0:
-            # The expert einsums (the FLOPs majority of an MoE block) have no
-            # int8 path yet; quantizing only the attention projections would
-            # silently sell bf16 serving as "int8". Refuse until implemented.
-            raise ValueError(
-                "quant='int8' is not supported for MoE towers yet "
-                "(moe_experts > 0): the expert dispatch/MLP einsums would "
-                "silently stay bf16 — serve MoE unquantized"
-            )
         x = x + Attention(
             self.width, self.num_heads, self.dtype,
             sp_axis=self.sp_axis, sp_impl=self.sp_impl,
@@ -272,6 +263,7 @@ class Block(nn.Module):
                 num_selected=self.moe_num_selected,
                 capacity_factor=self.moe_capacity_factor,
                 group_size=self.moe_group_size,
+                quant=self.quant,
                 name="moe",
             )
         else:

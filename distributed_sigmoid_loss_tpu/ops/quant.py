@@ -32,7 +32,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["int8_dot_general", "quantize_int8"]
+__all__ = ["int8_dot_general", "int8_expert_matmul", "quantize_int8"]
 
 # Symmetric int8: round-to-nearest into [-127, 127] (−128 unused, keeping the
 # scale symmetric so dequant is one multiply).
@@ -55,6 +55,29 @@ def quantize_int8(x: jnp.ndarray, axis: int):
         jnp.int8
     )
     return q, scale
+
+
+def int8_expert_matmul(x, w, out_dtype):
+    """Batched-expert int8 matmul: ``(E, ..., K) @ (E, K, M) -> (E, ..., M)``.
+
+    The MoE layer's expert MLP einsums (``encd,edh->ench`` / ``ench,ehd->encd``,
+    models/moe.py expert_apply) in dynamic int8: per-row activation scales over
+    K, per-(expert, out-channel) weight scales, int32 accumulation, expert as a
+    dot_general batch dim. Zero rows (unused capacity slots) quantize to exact
+    zeros. The one-hot dispatch/combine einsums stay in the model dtype — they
+    are <20% of the layer's FLOPs and carry the routing weights whose
+    precision sets drop behavior.
+    """
+    e = x.shape[0]
+    xq, xs = quantize_int8(x, axis=-1)          # xs (E, ..., 1)
+    wq, ws = quantize_int8(w, axis=1)           # ws (E, 1, M)
+    acc = lax.dot_general(
+        xq, wq,
+        (((x.ndim - 1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )                                            # (E, ..., M)
+    ws_b = ws.reshape((e,) + (1,) * (x.ndim - 2) + (w.shape[-1],))
+    return (acc.astype(jnp.float32) * xs * ws_b).astype(out_dtype)
 
 
 def int8_dot_general(lhs, rhs, dimension_numbers, precision=None,
