@@ -141,14 +141,15 @@ def _make_training_mesh(args):
     return make_2d_mesh(n_dev // args.ep, args.ep, axis_names=(data_axis, EP_AXIS)), None
 
 
-def _byte_tokenize_for(cfg):
-    """ByteTokenizer folded into the config's vocab when it's smaller (tiny test
+def _byte_tokenize_for(cfg, vocab_path: str = ""):
+    """Tokenizer folded into the config's vocab when it's smaller (tiny test
     configs): modulo keeps distinct texts distinct, where clamping would
     collapse them onto the max id. Shared by train (real-data loaders) and eval
-    (zero-shot prompts)."""
-    from distributed_sigmoid_loss_tpu.data import ByteTokenizer
+    (zero-shot prompts). ``vocab_path``: a trained BPE vocab (``tokenizer``
+    subcommand) instead of the byte-level default."""
+    from distributed_sigmoid_loss_tpu.data import BpeTokenizer, ByteTokenizer
 
-    tok = ByteTokenizer()
+    tok = BpeTokenizer.load(vocab_path) if vocab_path else ByteTokenizer()
 
     def tokenize(texts, length):
         import numpy as np
@@ -344,7 +345,7 @@ def cmd_train(args) -> int:
             ImageTextShards,
         )
 
-        tokenize = _byte_tokenize_for(cfg)
+        tokenize = _byte_tokenize_for(cfg, args.tokenizer)
         native_decode = False
         if args.native_decode:
             from distributed_sigmoid_loss_tpu.data.native_decode import (
@@ -476,6 +477,15 @@ def cmd_train(args) -> int:
             if i >= skip:
                 yield place(b)
 
+    if args.ckpt_dir and args.tokenizer:
+        # Stash the vocab with the checkpoints: eval auto-loads it, so restored
+        # models never silently tokenize with a different vocab than training.
+        import shutil
+
+        os.makedirs(args.ckpt_dir, exist_ok=True)
+        stash = os.path.join(args.ckpt_dir, "tokenizer.json")
+        if os.path.abspath(args.tokenizer) != os.path.abspath(stash):
+            shutil.copyfile(args.tokenizer, stash)
     if args.ckpt_dir:
         # Preemption-safe resilient loop: resumes from the newest checkpoint in
         # --ckpt-dir, saves every --ckpt-every steps and on SIGTERM, rolls back
@@ -558,6 +568,26 @@ def cmd_eval(args) -> int:
         )
         return 2
     cfg = _model_config(args)
+    if args.ckpt_dir:
+        # Use the vocab stashed by `train --tokenizer` unless the user overrode
+        # it — silently tokenizing with a different vocab than training makes
+        # the metrics garbage with no error.
+        stashed = os.path.join(args.ckpt_dir, "tokenizer.json")
+        if os.path.exists(stashed):
+            if not args.tokenizer:
+                args.tokenizer = stashed
+                print(f"using checkpoint tokenizer {stashed}", file=sys.stderr)
+            elif os.path.abspath(args.tokenizer) != os.path.abspath(stashed):
+                import json as jsonmod
+
+                with open(args.tokenizer) as f1, open(stashed) as f2:
+                    if jsonmod.load(f1) != jsonmod.load(f2):
+                        print(
+                            f"WARNING: --tokenizer {args.tokenizer} differs "
+                            f"from the checkpoint's stashed vocab {stashed}; "
+                            "token ids will not match training",
+                            file=sys.stderr,
+                        )
     mesh = make_mesh()
     model = SigLIP(cfg)
 
@@ -574,7 +604,7 @@ def cmd_eval(args) -> int:
             ImageTextShards,
         )
 
-        tokenize = _byte_tokenize_for(cfg)
+        tokenize = _byte_tokenize_for(cfg, args.tokenizer)
         if args.data_dir:
             source = ImageTextFolder(
                 args.data_dir, cfg, args.batch, tokenize, keep_captions=True
@@ -666,7 +696,7 @@ def cmd_eval(args) -> int:
 
     from distributed_sigmoid_loss_tpu.eval import build_classifier
 
-    tokenize = _byte_tokenize_for(cfg)
+    tokenize = _byte_tokenize_for(cfg, args.tokenizer)
     if captions is not None:
         # Real data: the batch's distinct captions ARE the label space — each
         # image's true class is its own caption (caption-matching zero-shot, the
@@ -839,6 +869,43 @@ def cmd_bench(extra: list[str]) -> int:
     os.execv(sys.executable, [sys.executable, bench] + extra)
 
 
+def cmd_tokenizer(args) -> int:
+    """Train a BPE vocab from captions and write it as json."""
+    import glob as globmod
+
+    from distributed_sigmoid_loss_tpu.data import BpeTokenizer
+
+    if bool(args.data_dir) == bool(args.text_file):
+        print("pass exactly one of --data-dir or --text-file", file=sys.stderr)
+        return 2
+    if args.data_dir:
+        paths = sorted(globmod.glob(os.path.join(args.data_dir, "*.txt")))
+        if not paths:
+            print(f"no *.txt captions under {args.data_dir!r}", file=sys.stderr)
+            return 2
+        texts = []
+        for path in paths:
+            with open(path, encoding="utf-8") as f:
+                texts.append(f.read().strip())
+    else:
+        with open(args.text_file, encoding="utf-8") as f:
+            texts = [line.strip() for line in f if line.strip()]
+    if not texts:
+        print("corpus is empty (no non-blank captions)", file=sys.stderr)
+        return 2
+    tok = BpeTokenizer.train(texts, args.vocab_size)
+    tok.save(args.out)
+    n_merges = len(tok.merges)
+    sample = texts[0][:60]
+    ratio = len(sample.encode("utf-8")) / max(1, len(tok.encode(sample)) - 2)
+    print(
+        f"trained {n_merges} merges (vocab {tok.vocab_size}) from "
+        f"{len(texts)} captions -> {args.out}; "
+        f"~{ratio:.2f} bytes/token on a sample"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="distributed_sigmoid_loss_tpu", description=__doc__
@@ -847,6 +914,10 @@ def main(argv=None) -> int:
 
     tr = sub.add_parser("train", help="end-to-end SigLIP training (synthetic data)")
     tr.add_argument("--steps", type=int, default=20)
+    tr.add_argument("--tokenizer", default="",
+                    help="trained BPE vocab json (see the `tokenizer` "
+                         "subcommand); default = byte-level tokenizer")
+
     tr.add_argument("--batch", type=int, default=64, help="global batch size")
     tr.add_argument("--variant", choices=["all_gather", "ring"], default="ring")
     tr.add_argument("--loss-family", choices=["sigmoid", "softmax"],
@@ -936,6 +1007,9 @@ def main(argv=None) -> int:
                     help="this process's 0-based rank (required with --coordinator)")
 
     ev = sub.add_parser("eval", help="zero-shot retrieval + classification")
+    ev.add_argument("--tokenizer", default="",
+                    help="trained BPE vocab json (see the `tokenizer` "
+                         "subcommand); default = byte-level tokenizer")
     ev.add_argument("--batch", type=int, default=64)
     ev.add_argument("--classes", type=int, default=10)
     ev.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"], default="b16")
@@ -960,6 +1034,18 @@ def main(argv=None) -> int:
                          "(v5e int8 MXU = 2x bf16 peak; inference-only)")
     ev.add_argument("--ema", action="store_true",
                     help="evaluate the checkpoint's EMA weights (train --ema-decay)")
+
+    tk = sub.add_parser(
+        "tokenizer",
+        help="train a byte-level BPE vocab on a caption corpus (data/tokenizer.py)",
+    )
+    tk.add_argument("out", help="output vocab json path")
+    tk.add_argument("--vocab-size", type=int, default=4096)
+    tk.add_argument("--data-dir", default="",
+                    help="directory of name.txt caption files (the "
+                         "ImageTextFolder layout)")
+    tk.add_argument("--text-file", default="",
+                    help="plain text file, one caption per line")
 
     ex = sub.add_parser(
         "export",
@@ -1025,6 +1111,7 @@ def main(argv=None) -> int:
         "train": cmd_train,
         "eval": cmd_eval,
         "export": cmd_export,
+        "tokenizer": cmd_tokenizer,
         "bench": lambda a: cmd_bench(a.rest),
     }
     return dispatch[args.cmd](args)

@@ -8,7 +8,10 @@ from distributed_sigmoid_loss_tpu.data.synthetic import (  # noqa: F401
     SyntheticImageText,
     shard_batch,
 )
-from distributed_sigmoid_loss_tpu.data.tokenizer import ByteTokenizer  # noqa: F401
+from distributed_sigmoid_loss_tpu.data.tokenizer import (  # noqa: F401
+    BpeTokenizer,
+    ByteTokenizer,
+)
 from distributed_sigmoid_loss_tpu.data.native_loader import (  # noqa: F401
     NativeSyntheticImageText,
     native_available,

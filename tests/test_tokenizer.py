@@ -1,6 +1,7 @@
 """Byte tokenizer: roundtrip, padding/truncation, tower integration."""
 
 import numpy as np
+import pytest
 
 from distributed_sigmoid_loss_tpu.data.tokenizer import ByteTokenizer
 
@@ -66,3 +67,87 @@ def test_feeds_text_tower():
     z = model.apply(params, tokens)
     assert z.shape == (2, cfg.embed_dim)
     assert np.isfinite(np.asarray(z)).all()
+
+
+# -- trainable byte-level BPE (data.BpeTokenizer) -------------------------------
+
+
+def _corpus():
+    return [
+        "a photo of a cat sitting on a mat",
+        "a photo of a dog running in the park",
+        "the cat and the dog play in the park",
+        "a painting of a cat in the style of monet",
+    ] * 4
+
+
+def test_bpe_zero_merges_is_byte_tokenizer():
+    from distributed_sigmoid_loss_tpu.data import BpeTokenizer, ByteTokenizer
+
+    bpe, byte = BpeTokenizer(), ByteTokenizer()
+    text = "hello world"
+    assert bpe.encode(text) == byte.encode(text)
+    assert bpe.vocab_size == byte.vocab_size
+
+
+def test_bpe_train_compresses_and_roundtrips():
+    from distributed_sigmoid_loss_tpu.data import BpeTokenizer, ByteTokenizer
+
+    tok = BpeTokenizer.train(_corpus(), vocab_size=400)
+    assert len(tok.merges) > 0
+    byte = ByteTokenizer()
+    for text in _corpus()[:4] + ["unseen words still encode fine", "čćž utf-8"]:
+        ids = tok.encode(text)
+        assert tok.decode(ids) == text  # lossless, any input
+        assert max(ids) < tok.vocab_size
+    # On in-domain text the learned merges compress vs raw bytes.
+    sample = _corpus()[0]
+    assert len(tok.encode(sample)) < len(byte.encode(sample))
+
+
+def test_bpe_train_is_deterministic():
+    from distributed_sigmoid_loss_tpu.data import BpeTokenizer
+
+    a = BpeTokenizer.train(_corpus(), vocab_size=350)
+    b = BpeTokenizer.train(list(_corpus()), vocab_size=350)
+    assert a.merges == b.merges
+
+
+def test_bpe_save_load_roundtrip(tmp_path):
+    from distributed_sigmoid_loss_tpu.data import BpeTokenizer
+
+    tok = BpeTokenizer.train(_corpus(), vocab_size=320)
+    path = str(tmp_path / "vocab.json")
+    tok.save(path)
+    tok2 = BpeTokenizer.load(path)
+    assert tok2.merges == tok.merges
+    text = "a photo of a dog"
+    assert tok2.encode(text) == tok.encode(text)
+    with pytest.raises(ValueError, match="dsl-bpe-v1"):
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            f.write("{}")
+        BpeTokenizer.load(bad)
+
+
+def test_bpe_batch_call_shape_and_padding():
+    from distributed_sigmoid_loss_tpu.data import BpeTokenizer
+
+    tok = BpeTokenizer.train(_corpus(), vocab_size=320)
+    out = tok(["a photo of a cat", "x"], 16)
+    assert out.shape == (2, 16) and out.dtype == np.int32
+    assert out[0, 0] == tok.bos_id and tok.pad_id in out[1]
+
+
+def test_bpe_cli_trains_and_feeds_train(tmp_path):
+    from distributed_sigmoid_loss_tpu.cli import main
+
+    corpus_file = tmp_path / "caps.txt"
+    corpus_file.write_text("\n".join(_corpus()))
+    vocab = str(tmp_path / "vocab.json")
+    rc = main(["tokenizer", vocab, "--text-file", str(corpus_file),
+               "--vocab-size", "300"])
+    assert rc == 0
+    from distributed_sigmoid_loss_tpu.data import BpeTokenizer
+
+    assert BpeTokenizer.load(vocab).vocab_size <= 300
