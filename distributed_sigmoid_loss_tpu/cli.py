@@ -477,6 +477,32 @@ def cmd_train(args) -> int:
             if i >= skip:
                 yield place(b)
 
+    eval_hook = None
+    if args.eval_every:
+        from distributed_sigmoid_loss_tpu.eval import retrieval_metrics as _rm
+
+        # ONE fixed held-out batch for every in-training eval: the curve then
+        # measures the model, not data drift. (Synthetic pipelines are
+        # deterministic per index; real loaders just take their next batch.)
+        eval_batch = place(next(iter(data)))
+        # Jitted once: the hook runs repeatedly inside the train loop, where
+        # an eager per-op forward would dominate wall time on real models.
+        eval_fwd = jax.jit(
+            lambda p, im, tk: model.apply({"params": p}, im, tk)[:2]
+        )
+
+        def eval_hook(step_i, st):
+            zi, zt = eval_fwd(
+                st.params, eval_batch["images"], eval_batch["tokens"]
+            )
+            rm = _rm(zi, zt, mesh=mesh, ks=(1, 5))
+            # force: eval steps are out-of-band of --log-every (and must not
+            # touch the steps/sec clock).
+            logger.log(
+                step_i, {f"eval/{k}": float(v) for k, v in rm.items()},
+                force=True,
+            )
+
     if args.ckpt_dir and args.tokenizer:
         # Stash the vocab with the checkpoints: eval auto-loads it, so restored
         # models never silently tokenize with a different vocab than training.
@@ -516,6 +542,8 @@ def cmd_train(args) -> int:
                     on_metrics=lambda i, m: logger.log(
                         i, {k: float(v) for k, v in m.items()}
                     ),
+                    eval_every=args.eval_every,
+                    on_eval=eval_hook,
                 )
             except RestoreRequiredError as e:
                 print(f"--ckpt-dir {args.ckpt_dir}: {e}", file=sys.stderr)
@@ -531,6 +559,8 @@ def cmd_train(args) -> int:
         for i, batch in zip(range(1, args.steps + 1), device_batches()):
             state, metrics = step_fn(state, batch)
             logger.log(i, {k: float(v) for k, v in metrics.items()})
+            if eval_hook is not None and i % args.eval_every == 0:
+                eval_hook(i, state)
 
     # Zero-shot retrieval on a held-out synthetic batch (the model normalizes
     # its embeddings already).
@@ -995,6 +1025,10 @@ def main(argv=None) -> int:
                          "step loop overlaps the save IO instead of stalling "
                          "for it (seconds per save at so400m scale)")
     tr.add_argument("--ckpt-every", type=int, default=50)
+    tr.add_argument("--eval-every", type=int, default=0, metavar="N",
+                    help="every N steps, log zero-shot retrieval metrics "
+                         "(eval/i2t_recall@K ...) on one fixed held-out batch "
+                         "— the in-training validation curve")
     tr.add_argument("--log-every", type=int, default=1)
     tr.add_argument("--coordinator", default="",
                     help="multi-process rendezvous address host:port — every "

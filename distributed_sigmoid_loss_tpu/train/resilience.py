@@ -213,6 +213,8 @@ def train_resilient(
     check_finite_every: int = 1,
     require_restore: bool = False,
     saver=None,
+    eval_every: int = 0,
+    on_eval: Callable[[int, Any], None] | None = None,
 ) -> tuple[Any, ResilienceReport]:
     """Run ``step_fn`` to ``total_steps`` with checkpoint/resume, preemption
     checkpointing, and divergence detection.
@@ -247,6 +249,11 @@ def train_resilient(
     so400m scale). The loop ``wait()``s before any rollback restore (the
     newest checkpoint must be durable to be restorable) and before returning,
     so the report's ``checkpoints`` are always durable by exit.
+
+    ``eval_every`` + ``on_eval``: every that many steps, ``on_eval(step,
+    state)`` runs between the update and the checkpoint decision — the
+    in-training validation hook (it may sync the device; that is the caller's
+    choice to make, same contract as ``on_metrics``).
     """
     report = ResilienceReport()
     resumed = restore_latest(ckpt_dir, state)
@@ -306,6 +313,8 @@ def train_resilient(
         step += 1
         if on_metrics is not None:
             on_metrics(step, metrics)
+        if on_eval is not None and eval_every and step % eval_every == 0:
+            on_eval(step, state)
 
         preempted = guard is not None and guard.reached_sync_point(step)
         if preempted or step % ckpt_every == 0 or step == total_steps:

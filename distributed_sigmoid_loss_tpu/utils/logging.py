@@ -25,14 +25,22 @@ class MetricsLogger:
         self._last_time: float | None = None
         self._last_step: int | None = None
 
-    def log(self, step: int, metrics: Mapping[str, float]) -> None:
-        if step % self.every:
+    def log(self, step: int, metrics: Mapping[str, float], *,
+            force: bool = False) -> None:
+        """``force=True`` (out-of-band records, e.g. in-training eval) bypasses
+        the ``every`` filter AND leaves the steps/sec clock untouched — the
+        eval's wall time then lands in the next train interval, so logged
+        throughput honestly includes the eval overhead instead of hiding it."""
+        if step % self.every and not force:
             return
         now = time.perf_counter()
         record = {"step": step}
         record.update({k: float(v) for k, v in metrics.items()})
-        if self._last_time is not None and step > self._last_step:
-            record["steps_per_sec"] = (step - self._last_step) / (now - self._last_time)
-        self._last_time, self._last_step = now, step
+        if not force:
+            if self._last_time is not None and step > self._last_step:
+                record["steps_per_sec"] = (
+                    (step - self._last_step) / (now - self._last_time)
+                )
+            self._last_time, self._last_step = now, step
         self.stream.write(json.dumps(record) + "\n")
         self.stream.flush()

@@ -143,3 +143,26 @@ def test_loaded_artifact_composes_under_jit():
         return loaded.call(x)[0] + 1.0
 
     np.testing.assert_allclose(float(outer(x)), float(double_sum(x)) + 1.0)
+
+
+def test_cli_export_quant_forward_artifact(tmp_path):
+    """`export --quant int8 --what forward` writes a checkable artifact — the
+    int8 serving path survives jax.export lowering (quantize ops are plain
+    round/clip/dot, all StableHLO-exportable)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "fwd_int8.stablehlo")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_sigmoid_loss_tpu", "export", out,
+         "--tiny", "--cpu-devices", "2", "--batch", "4",
+         "--what", "forward", "--quant", "int8", "--check"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "check ok" in proc.stdout
+    assert os.path.getsize(out) > 0

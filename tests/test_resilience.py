@@ -293,3 +293,14 @@ def test_async_divergence_rollback_waits_for_inflight_save(tmp_path):
                 ckpt_dir=str(tmp_path / "ck"), ckpt_every=4, saver=saver,
             )
     assert ei.value.restored_step == 4
+
+
+def test_on_eval_hook_fires_on_schedule(tmp_path):
+    step_fn, init_state = _make_step()
+    seen = []
+    train_resilient(
+        init_state, step_fn, _batches(7), total_steps=7,
+        ckpt_dir=str(tmp_path / "ck"), ckpt_every=100,
+        eval_every=3, on_eval=lambda s, st: seen.append(s),
+    )
+    assert seen == [3, 6]
