@@ -481,10 +481,22 @@ def cmd_train(args) -> int:
     if args.eval_every:
         from distributed_sigmoid_loss_tpu.eval import retrieval_metrics as _rm
 
-        # ONE fixed held-out batch for every in-training eval: the curve then
-        # measures the model, not data drift. (Synthetic pipelines are
-        # deterministic per index; real loaders just take their next batch.)
-        eval_batch = place(next(iter(data)))
+        # ONE fixed batch for every in-training eval: the curve then measures
+        # the model, not data drift. It must NOT be drawn from the live
+        # training iterator: that would shift every subsequent stream
+        # position, so a resume with a different --eval-every would silently
+        # train on a different stream than the original run (breaking
+        # device_batches' skip arithmetic). Synthetic runs get a genuinely
+        # held-out source (shifted seeds); file/native streams reuse the
+        # already-drawn position-0 batch.
+        if isinstance(source, SyntheticImageText):
+            eval_batch = place(
+                next(iter(SyntheticImageText(
+                    cfg, args.batch, image_seed=43, text_seed=41
+                )))
+            )
+        else:
+            eval_batch = place(first)
         # Jitted once: the hook runs repeatedly inside the train loop, where
         # an eager per-op forward would dominate wall time on real models.
         eval_fwd = jax.jit(
@@ -1027,8 +1039,11 @@ def main(argv=None) -> int:
     tr.add_argument("--ckpt-every", type=int, default=50)
     tr.add_argument("--eval-every", type=int, default=0, metavar="N",
                     help="every N steps, log zero-shot retrieval metrics "
-                         "(eval/i2t_recall@K ...) on one fixed held-out batch "
-                         "— the in-training validation curve")
+                         "(eval/i2t_recall@K ...) on one fixed batch — the "
+                         "in-training validation curve. Synthetic runs use a "
+                         "genuinely held-out batch (shifted seeds); file/"
+                         "native streams reuse the first training batch, so "
+                         "the curve there includes train-set fit")
     tr.add_argument("--log-every", type=int, default=1)
     tr.add_argument("--coordinator", default="",
                     help="multi-process rendezvous address host:port — every "

@@ -55,12 +55,12 @@ _VMEM_BUDGET_FRACTION = 0.7
 def short_attention_vmem_bytes(s: int, width: int, dtype_bytes: int) -> int:
     """Worst-case VMEM footprint of ONE grid program (width = h·dh).
 
-    The backward program is the peak: 8 (s, width) I/O blocks (q, k, v, o, do,
-    dq, dk, dv) resident for the whole program, plus ~3 live (s, s) f32
-    per-head intermediates (e, dp, t — the compiler can reuse across heads but
-    not within the chain).
+    The backward program is the peak: 7 (s, width) I/O blocks (q, k, v, do, dq, dk,
+    dv) resident for the whole program, plus ~3 live (s, s) f32 per-head
+    intermediates (probs, dp, ds — the compiler can reuse across heads but not
+    within the chain).
     """
-    return 8 * s * width * dtype_bytes + 3 * s * s * 4
+    return 7 * s * width * dtype_bytes + 3 * s * s * 4
 
 
 def short_attention_fits(s: int, width: int, dtype_bytes: int) -> bool:
@@ -83,21 +83,14 @@ def _dot(a, b, contract_a: int, contract_b: int):
     )
 
 
-def _head_exp(qh, kh, *, scale, causal):
-    """Unnormalized softmax numerator ``e = exp(scale·q@kᵀ − rowmax)`` and its
-    row sums ``r``. The division by ``r`` is deliberately NOT done here: every
-    consumer folds it into an (s, dh)-sized operand instead, so normalization
-    never costs an (s, s) VPU pass (the kernels are VPU-bound, not MXU-bound —
-    measured 20-28 TF/s on chip with matmul fusions at 175, docs/PERF.md).
-    ``scale`` is likewise folded into the (s, dh) q operand, not the logits."""
-    logits = _dot(qh * scale, kh, 1, 1)  # (s, s)
+def _head_probs(qh, kh, *, scale, causal):
+    logits = _dot(qh, kh, 1, 1) * scale  # (s, s)
     if causal:
         s = logits.shape[0]
         rows = lax.broadcasted_iota(jnp.int32, (s, s), 0)
         cols = lax.broadcasted_iota(jnp.int32, (s, s), 1)
         logits = jnp.where(rows >= cols, logits, _NEG_INF)
-    e = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
-    return e, jnp.sum(e, axis=-1, keepdims=True)  # (s, s), (s, 1)
+    return jax.nn.softmax(logits, axis=-1)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, num_heads):
@@ -105,46 +98,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, num_heads):
     dh = q.shape[-1] // num_heads
     for j in range(num_heads):
         sl = slice(j * dh, (j + 1) * dh)
-        e, r = _head_exp(q[:, sl], k[:, sl], scale=scale, causal=causal)
-        # out = (e @ v) / r: the softmax division lands on (s, dh), not (s, s).
-        o_ref[0, :, sl] = (
-            _dot(e.astype(v.dtype), v[:, sl], 1, 0) / r
-        ).astype(o_ref.dtype)
+        p = _head_probs(q[:, sl], k[:, sl], scale=scale, causal=causal)
+        o_ref[0, :, sl] = _dot(p.astype(v.dtype), v[:, sl], 1, 0).astype(o_ref.dtype)
 
 
 def _bwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, dq_ref, dk_ref, dv_ref,
-    *, scale, causal, num_heads,
+    q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref, *, scale, causal, num_heads
 ):
-    q, k, v, o, do = q_ref[0], k_ref[0], v_ref[0], o_ref[0], do_ref[0]
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
     dh = q.shape[-1] // num_heads
     for j in range(num_heads):
         sl = slice(j * dh, (j + 1) * dh)
         qh, kh, vh, doh = q[:, sl], k[:, sl], v[:, sl], do[:, sl]
-        # Recompute this head's unnormalized probs entirely in VMEM.
-        e, r = _head_exp(qh, kh, scale=scale, causal=causal)  # (s, s) f32
-        e_lo = e.astype(vh.dtype)
-        inv_r = 1.0 / r  # (s, 1)
-        # dv = pᵀ @ do = eᵀ @ (do / r): fold the row normalization into do.
-        dvh = _dot(e_lo, (doh * inv_r).astype(vh.dtype), 0, 0)
-        # Softmax VJP with the flash-attention delta trick:
-        #   δ_i = Σ_j p_ij·dp_ij = do_i · o_i  — an (s, dh) product, replacing
-        #   the (s, s) dp⊙p multiply + row reduce.
-        delta = jnp.sum(
-            doh.astype(jnp.float32) * o[:, sl].astype(jnp.float32),
-            axis=-1, keepdims=True,
-        )  # (s, 1)
-        dp = _dot(doh.astype(vh.dtype), vh, 1, 1)  # (s, s): do @ vᵀ
-        t = (e * (dp - delta)).astype(qh.dtype)  # ds·r (unnormalized)
-        # dq = (t @ k)·scale/r ; dk = tᵀ @ (q·scale/r): normalization and the
-        # logit scale both fold into (s, dh) operands.
-        dq_ref[0, :, sl] = (_dot(t, kh, 1, 0) * (scale * inv_r)).astype(
-            dq_ref.dtype
+        # Recompute this head's probs entirely in VMEM.
+        p = _head_probs(qh, kh, scale=scale, causal=causal)  # (s, s) f32
+        p_lo = p.astype(vh.dtype)
+        do_lo = doh.astype(vh.dtype)
+        dv_ref[0, :, sl] = _dot(p_lo, do_lo, 0, 0).astype(dv_ref.dtype)  # pᵀ @ do
+        dp = _dot(do_lo, vh, 1, 1)  # (s, s): do @ vᵀ
+        # Softmax VJP: ds = p ⊙ (dp − rowsum(dp ⊙ p)), then the logits scale.
+        ds = ((p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))) * scale).astype(
+            qh.dtype
         )
-        dk_ref[0, :, sl] = _dot(
-            t, (qh * (scale * inv_r)).astype(qh.dtype), 0, 0
-        ).astype(dk_ref.dtype)
-        dv_ref[0, :, sl] = dvh.astype(dv_ref.dtype)
+        dq_ref[0, :, sl] = _dot(ds, kh, 1, 0).astype(dq_ref.dtype)  # ds @ k
+        dk_ref[0, :, sl] = _dot(ds, qh, 0, 0).astype(dk_ref.dtype)  # dsᵀ @ q
 
 
 def _specs(b, s, width, n: int):
@@ -185,18 +162,15 @@ def _short_attention_fwd(q, k, v, causal, scale, interpret):
         ),
         interpret=interpret,
     )(q.reshape(wide), k.reshape(wide), v.reshape(wide))
-    out = out.reshape(q.shape)
-    # out joins the residuals for the backward's delta = rowsum(do ⊙ o) trick
-    # (one (b, s, width) buffer — the price of never materializing dp ⊙ p).
-    return out, (q, k, v, out)
+    return out.reshape(q.shape), (q, k, v)
 
 
 def _short_attention_bwd(causal, scale, interpret, residuals, g):
-    q, k, v, out = residuals
+    q, k, v = residuals
     b, s, h, dh = q.shape
     scale_v = (dh**-0.5) if scale is None else scale
     wide = (b, s, h * dh)
-    spec = _specs(b, s, h * dh, 5)
+    spec = _specs(b, s, h * dh, 4)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, scale=scale_v, causal=causal, num_heads=h),
         out_shape=[jax.ShapeDtypeStruct(wide, q.dtype)] * 3,
@@ -205,14 +179,11 @@ def _short_attention_bwd(causal, scale, interpret, residuals, g):
         out_specs=[spec["out_specs"]] * 3,
         cost_estimate=pl.CostEstimate(
             flops=_flops(b, s, h * dh, 5),
-            bytes_accessed=8 * q.size * q.dtype.itemsize,
+            bytes_accessed=7 * q.size * q.dtype.itemsize,
             transcendentals=b * h * s * s,
         ),
         interpret=interpret,
-    )(
-        q.reshape(wide), k.reshape(wide), v.reshape(wide),
-        out.reshape(wide), g.reshape(wide),
-    )
+    )(q.reshape(wide), k.reshape(wide), v.reshape(wide), g.reshape(wide))
     shape = q.shape
     return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 

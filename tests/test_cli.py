@@ -38,6 +38,28 @@ def test_train_tiny_smoke():
     assert "i2t_recall@1" in proc.stderr
 
 
+def test_eval_every_does_not_shift_training_stream():
+    """--eval-every must not consume from the training iterator: the per-step
+    losses with and without it are identical, so a resume that adds/changes
+    --eval-every still trains on the same deterministic stream (the
+    device_batches skip-arithmetic contract)."""
+    base = ["train", "--cpu-devices", "8", "--tiny", "--steps", "3",
+            "--batch", "16"]
+    plain = _run(base)
+    with_eval = _run(base + ["--eval-every", "2"])
+    assert plain.returncode == 0, plain.stderr[-2000:]
+    assert with_eval.returncode == 0, with_eval.stderr[-2000:]
+
+    def losses(p):
+        recs = [json.loads(l) for l in p.stdout.splitlines() if l.startswith("{")]
+        return {r["step"]: r["loss"] for r in recs if "loss" in r}
+
+    assert losses(plain) == losses(with_eval)
+    evals = [json.loads(l) for l in with_eval.stdout.splitlines()
+             if l.startswith("{") and "eval/i2t_recall@1" in l]
+    assert [e["step"] for e in evals] == [2]
+
+
 def test_eval_tiny_smoke():
     proc = _run(
         ["eval", "--cpu-devices", "8", "--tiny", "--batch", "16", "--classes", "4"]
