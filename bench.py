@@ -265,16 +265,16 @@ def run_eval_throughput(args) -> int:
     )
     params = model.init(key, images[:2], tokens[:2])["params"]
 
-    fwd = jax.jit(lambda p, im, tk: model.apply({"params": p}, im, tk)[:2])
-    zi, zt = fwd(params, images, tokens)
-    float(jnp.sum(zi).astype(jnp.float32))  # drain (axon sync caveat)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        zi, zt = fwd(params, images, tokens)
-    float(jnp.sum(zi).astype(jnp.float32) + jnp.sum(zt).astype(jnp.float32))
-    dt = time.perf_counter() - t0
+    from distributed_sigmoid_loss_tpu.utils.profiling import time_step
 
-    pairs_per_sec = args.batch * args.steps / dt
+    fwd = jax.jit(lambda p, im, tk: model.apply({"params": p}, im, tk)[:2])
+    # time_step's 3 warmup calls matter here: through the tunneled runtime the
+    # first dispatches of a fresh executable run far slower than steady state
+    # (the int8 path measured 733 pairs/s at --steps 10 vs 2996 at --steps 30
+    # with a single warmup — docs/PERF.md round-3 serving section).
+    dt = time_step(fwd, params, images, tokens, warmup=3, iters=args.steps)
+
+    pairs_per_sec = args.batch / dt
     device_kind = jax.devices()[0].device_kind
     fwd_flops = model_forward_flops_per_pair(cfg)
     tflops = fwd_flops * pairs_per_sec / 1e12

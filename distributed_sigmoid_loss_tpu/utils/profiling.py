@@ -40,17 +40,35 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
-def time_step(fn: Callable, *args, warmup: int = 2, iters: int = 10) -> float:
+def _drain(out) -> None:
+    """Force true completion of ``out``'s computation.
+
+    ``jax.block_until_ready`` alone returns early through the tunneled TPU
+    runtime (docs/PERF.md round-3 notes), so also transfer ONE element of the
+    first array leaf — a host transfer cannot complete before the producing
+    computation does, and a 1-element slice costs nothing on device.
+    """
+    jax.block_until_ready(out)
+    leaves = [x for x in jax.tree.leaves(out) if hasattr(x, "dtype")]
+    if leaves:
+        import numpy as np
+
+        np.asarray(jax.numpy.ravel(leaves[0])[:1])
+
+
+def time_step(fn: Callable, *args, warmup: int = 3, iters: int = 10) -> float:
     """Median-free wall-clock of ``fn(*args)`` per call, in seconds, with compile and
-    warmup excluded and device work fully drained."""
+    warmup excluded and device work fully drained (tunnel-safe — see _drain).
+    Three warmup calls by default: the first dispatches of a fresh executable
+    through the tunneled runtime run far slower than steady state."""
     out = None
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _drain(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _drain(out)
     return (time.perf_counter() - t0) / iters
 
 
