@@ -35,3 +35,9 @@ from distributed_sigmoid_loss_tpu.parallel.pipeline import (  # noqa: F401
     make_layer_stage_fn,
     stack_stage_params,
 )
+from distributed_sigmoid_loss_tpu.parallel.compression import (  # noqa: F401
+    compressed_axis_mean,
+    init_error_feedback,
+    quantize_tensor_int8,
+    dequantize_tensor_int8,
+)

@@ -29,3 +29,7 @@ from distributed_sigmoid_loss_tpu.train.ema import (  # noqa: F401
     init_ema,
     update_ema,
 )
+from distributed_sigmoid_loss_tpu.train.compressed_step import (  # noqa: F401
+    make_compressed_train_step,
+    with_error_feedback,
+)

@@ -1,0 +1,171 @@
+"""Train step with compressed gradient sync over the DCN (cross-slice) axis.
+
+The regular :func:`~distributed_sigmoid_loss_tpu.train.train_step.make_train_step`
+leaves gradient synchronization to XLA: autodiff of the pmean'd loss inserts
+one fused f32 all-reduce over the whole data axis. That is the right call
+within a slice (ICI), but across slices the same bytes ride DCN — the slow
+link the reference's NCCL world also crosses (its Gloo/NCCL ``all_reduce``,
+/root/reference/test_distributed_sigmoid_loss.py:79-83). This step makes the
+sync explicit and splits it by link speed, the way the reference harness's
+``average_gradients`` is explicit:
+
+- grads are computed per-device under a **fully-manual** ``shard_map`` over
+  ``(dcn, dp)`` (the towers are pure batch functions; everything else in the
+  mesh stays compiler-managed),
+- the ``dp`` hop is a plain f32 ``psum`` (ICI),
+- the ``dcn`` hop is an int8 all-gather + local mean with error feedback
+  (parallel/compression.py) — ~4x fewer bytes on the slow wire.
+
+Grad oracle (tests/test_grad_compression.py): identical structure to the
+uncompressed step, per-tensor rel err < 1% single-shot and unbiased over
+steps with error feedback.
+
+v1 scope: dense towers, ``variant="all_gather"`` (the ring's ppermute has no
+joint-axis form), no accumulation/pp/MoE — each raises with a pointer to the
+regular step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_sigmoid_loss_tpu.parallel.compression import (
+    compressed_axis_mean,
+    init_error_feedback,
+)
+from distributed_sigmoid_loss_tpu.train.train_step import (
+    TrainState,
+    zero1_constrain,
+)
+from distributed_sigmoid_loss_tpu.utils.config import LossConfig
+
+__all__ = ["make_compressed_train_step", "with_error_feedback"]
+
+
+def with_error_feedback(state: TrainState, mesh: Mesh, dcn_axis: str = "dcn"):
+    """Attach a zeroed error-feedback tree to ``state``, sharded over dcn."""
+    n = mesh.shape[dcn_axis]
+    ef = jax.jit(
+        lambda p: init_error_feedback(p, n),
+        out_shardings=jax.tree.map(
+            lambda _: NamedSharding(mesh, P(dcn_axis)), state.params
+        ),
+    )(state.params)
+    return state.replace(ef=ef)
+
+
+def make_compressed_train_step(
+    model: nn.Module,
+    mesh: Mesh,
+    loss_cfg: LossConfig = LossConfig(),
+    dcn_axis: str = "dcn",
+    error_feedback: bool = True,
+    zero1: bool = False,
+):
+    """Build ``(state, batch) -> (state, metrics)`` with int8 DCN grad sync.
+
+    ``mesh`` must carry ``(dcn_axis, dp axis)``; the batch shards over both.
+    With ``error_feedback=True`` create the state via
+    :func:`with_error_feedback` (the step raises otherwise). Metrics gain
+    ``ef_norm`` — the global norm of the carried residual, a live view of how
+    much signal the int8 wire deferred (should stay ~flat, not grow).
+    """
+    if loss_cfg.variant != "all_gather":
+        raise ValueError(
+            "compressed DCN sync supports variant='all_gather' only (the ring "
+            "ppermute has no joint-(dcn,dp) axis form); use make_train_step "
+            "for ring training within a slice"
+        )
+    axis = loss_cfg.axis_name
+    from distributed_sigmoid_loss_tpu.parallel.api import make_per_shard_loss
+    from distributed_sigmoid_loss_tpu.train.train_step import _precision
+
+    per_shard = make_per_shard_loss(
+        family=loss_cfg.family, variant="all_gather",
+        axis_name=(dcn_axis, axis), bidir=loss_cfg.bidir,
+        precision=_precision(loss_cfg.precision),
+    )
+
+    def local_loss(params, images, tokens):
+        # Per-DEVICE loss only — collectives live in per_shard (whose
+        # all_gather/VJP route cross-device cotangents); no pmean here (its
+        # transpose under check_vma=False is psum — a W-times overcount).
+        zimg, ztxt, lp = model.apply({"params": params}, images, tokens)
+        return per_shard(zimg, ztxt, lp["t_prime"], lp["bias"]), lp
+
+    def grads_body(params, images, tokens, ef):
+        (ell, lp), grads = jax.value_and_grad(local_loss, has_aux=True)(
+            params, images, tokens
+        )
+        n_dp = lax.axis_size(axis)
+        # Reference-style explicit DP sync (= all_reduce(SUM)/W), split by
+        # link: f32 psum-mean on ICI; compressed_axis_mean is itself a MEAN
+        # over dcn, so the two hops together divide by the full world size.
+        grads = jax.tree.map(lambda t: lax.psum(t, axis) / n_dp, grads)
+        grads, new_ef = compressed_axis_mean(grads, dcn_axis, ef)
+        loss = lax.pmean(lax.pmean(ell, axis), dcn_axis)
+        return loss, lp, grads, new_ef
+
+    ef_spec = P(dcn_axis)
+    data_spec = P((dcn_axis, axis))
+    # The synced grads/loss ARE replicated (post-gather identical on every
+    # member) but vma inference cannot prove it through the dequantized
+    # mean; unchecked like the loss island (parallel/api.py).
+    if error_feedback:
+        sharded_grads = jax.shard_map(
+            grads_body,
+            mesh=mesh,
+            in_specs=(P(), data_spec, data_spec, ef_spec),
+            out_specs=(P(), P(), P(), ef_spec),
+            check_vma=False,
+        )
+    else:
+        # No EF tree in flight at all: compressed_axis_mean's ef=None path.
+        sharded_grads = jax.shard_map(
+            lambda p, im, tk: grads_body(p, im, tk, None)[:3],
+            mesh=mesh,
+            in_specs=(P(), data_spec, data_spec),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+
+    def step(state: TrainState, batch: dict):
+        if error_feedback and state.ef is None:
+            raise ValueError(
+                "error_feedback=True but state.ef is None — create the state "
+                "with with_error_feedback(state, mesh)"
+            )
+        if error_feedback:
+            loss, lp, grads, new_ef = sharded_grads(
+                state.params, batch["images"], batch["tokens"], state.ef
+            )
+        else:
+            loss, lp, grads = sharded_grads(
+                state.params, batch["images"], batch["tokens"]
+            )
+        state = state.apply_gradients(grads=grads)
+        if zero1:
+            state = state.replace(
+                opt_state=zero1_constrain(state.opt_state, mesh, axis)
+            )
+        metrics = {
+            "loss": loss,
+            "t": jnp.exp(lp["t_prime"]),
+            "bias": lp["bias"],
+            "grad_norm": optax.global_norm(grads),
+        }
+        if error_feedback:
+            state = state.replace(ef=new_ef)
+            metrics["ef_norm"] = optax.global_norm(new_ef)
+        return state, metrics
+
+    batch_sharding = {
+        "images": NamedSharding(mesh, data_spec),
+        "tokens": NamedSharding(mesh, data_spec),
+    }
+    return jax.jit(step, donate_argnums=(0,)), batch_sharding

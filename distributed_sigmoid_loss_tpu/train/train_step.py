@@ -38,9 +38,12 @@ __all__ = [
 class TrainState(train_state.TrainState):
     """Flax train state + optional EMA of the params (``ema=None`` = disabled;
     as a pytree-None it adds no leaves, so states without EMA checkpoint and
-    shard exactly as before)."""
+    shard exactly as before). ``ef`` is the per-slice error-feedback residual
+    tree of compressed DCN gradient sync (train/compressed_step.py), None
+    when compression is off — same no-leaves contract as ``ema``."""
 
     ema: Any = None
+    ef: Any = None
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
