@@ -315,10 +315,19 @@ def cmd_train(args) -> int:
             reasons.append("--accum 1")
         if args.ema_decay is not None:
             reasons.append("no --ema-decay")
+        if args.grad_compression == "topk" and not (0 < args.topk_frac <= 1):
+            reasons.append(
+                f"--topk-frac in (0, 1], got {args.topk_frac} (it is the "
+                f"fraction of gradient entries kept per tensor)"
+            )
         if reasons:
             print("--grad-compression requires: " + "; ".join(reasons),
                   file=sys.stderr)
             return 2
+    if args.topk_frac != 0.01 and args.grad_compression != "topk":
+        print("--topk-frac without --grad-compression topk is a silent "
+              "no-op", file=sys.stderr)
+        return 2
     mesh, mesh_err = _make_training_mesh(args)
     if mesh_err:
         print(mesh_err, file=sys.stderr)
@@ -495,7 +504,7 @@ def cmd_train(args) -> int:
             with_error_feedback,
         )
 
-        # ef rides the state (and therefore checkpoints/restores) like ema.
+        # ef rides the live state only; checkpoints never include it (checkpoint._strip_ef), so compressed and plain runs share one checkpoint structure.
         state = with_error_feedback(state, mesh)
         step_fn, shardings = make_compressed_train_step(
             model,
@@ -503,6 +512,8 @@ def cmd_train(args) -> int:
             LossConfig(variant="all_gather", family=args.loss_family,
                        precision="default"),
             zero1=args.zero1,
+            compression=args.grad_compression,
+            topk_frac=args.topk_frac,
         )
     else:
         step_fn, shardings = make_train_step(
@@ -1116,11 +1127,16 @@ def main(argv=None) -> int:
                     help="multi-slice topology: a separate dcn mesh axis of "
                          "size N outermost (cross-slice DCN links), dp inside "
                          "(ICI) — pair with --grad-compression")
-    tr.add_argument("--grad-compression", choices=["int8"], default="",
+    tr.add_argument("--grad-compression", choices=["int8", "topk"],
+                    default="",
                     help="compress the gradient sync over the dcn axis: f32 "
-                         "psum on ICI, int8 all-gather + error feedback on "
-                         "DCN (~4x fewer bytes on the slow wire; "
-                         "train/compressed_step.py)")
+                         "psum on ICI; on DCN either int8 all-gather (~4x "
+                         "fewer bytes) or top-k sparsification (~50x at the "
+                         "default 1%%), both with error feedback "
+                         "(train/compressed_step.py)")
+    tr.add_argument("--topk-frac", type=float, default=0.01, metavar="F",
+                    help="fraction of entries kept per tensor under "
+                         "--grad-compression topk")
     tr.add_argument("--ema-decay", type=float, default=None,
                     help="maintain an EMA of the params in the train state "
                          "(e.g. 0.9999, warmed up)")

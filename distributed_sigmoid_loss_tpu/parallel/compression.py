@@ -33,6 +33,8 @@ from jax import lax
 __all__ = [
     "quantize_tensor_int8",
     "dequantize_tensor_int8",
+    "sparsify_topk",
+    "densify_topk",
     "compressed_axis_mean",
     "init_error_feedback",
 ]
@@ -60,6 +62,25 @@ def dequantize_tensor_int8(q: jax.Array, scale: jax.Array) -> jax.Array:
     return q.astype(jnp.float32) * scale
 
 
+def sparsify_topk(t: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Top-``k``-by-magnitude sparsification: ``(values, flat_indices)``.
+
+    The OTHER standard wire format for gradient compression (deep gradient
+    compression / EF-SGD with sparsification): keep the k largest-|.| entries,
+    error feedback carries the rest. Wire cost 8 bytes/kept entry (f32 value +
+    int32 index) vs 4 bytes/entry dense — a win for k/size < ~1/2, typically
+    run at 1%.
+    """
+    flat = t.astype(jnp.float32).ravel()
+    _, idx = lax.top_k(jnp.abs(flat), k)
+    return flat[idx], idx.astype(jnp.int32)
+
+
+def densify_topk(values: jax.Array, idx: jax.Array, size: int) -> jax.Array:
+    """Scatter ``values`` back to a flat zeros(size) (inverse of sparsify)."""
+    return jnp.zeros((size,), jnp.float32).at[idx].add(values)
+
+
 def init_error_feedback(params, n_slices: int):
     """Zero error-feedback state: one f32 residual tree per DCN slice.
 
@@ -72,33 +93,54 @@ def init_error_feedback(params, n_slices: int):
     )
 
 
-def compressed_axis_mean(tree, axis_name: str, ef=None):
-    """Mean of ``tree`` over the (slow) ``axis_name`` with int8 on the wire.
+def compressed_axis_mean(tree, axis_name: str, ef=None, method: str = "int8",
+                         topk_frac: float = 0.01):
+    """Mean of ``tree`` over the (slow) ``axis_name`` with a compressed wire.
 
     Must run inside ``shard_map`` manual over ``axis_name``. ``tree`` holds
     this member's local contribution (already averaged over any fast axes).
     ``ef`` is this member's error-feedback tree (same structure, leaves with
     a leading size-1 slice dim from the ``P(axis_name)`` in_spec) or None.
 
+    ``method``: ``"int8"`` (per-tensor symmetric quantization, 4x fewer
+    bytes) or ``"topk"`` (top-``topk_frac``-by-magnitude sparsification,
+    8 bytes/kept entry — ~50x fewer at the standard 1%; run it WITH error
+    feedback, the dropped 99% is pure bias otherwise).
+
     Returns ``(mean_tree, new_ef)`` — ``mean_tree`` replicated over the axis,
-    ``new_ef`` the residual ``(t + ef) - dequant(quant(t + ef))`` to carry
-    into the next step (None if ``ef`` is None).
+    ``new_ef`` the residual ``(t + ef) - decompress(compress(t + ef))`` to
+    carry into the next step (None if ``ef`` is None).
     """
+    if method not in ("int8", "topk"):
+        raise ValueError(f"unknown compression method: {method!r}")
     n = lax.axis_size(axis_name)
 
     def one(t, e):
         target = t if e is None else t + jnp.squeeze(e, 0).astype(t.dtype)
-        q, s = quantize_tensor_int8(target)
+        if method == "int8":
+            q, s = quantize_tensor_int8(target)
+            sent = dequantize_tensor_int8(q, s)
+            qs = lax.all_gather(q, axis_name)    # int8 on the wire
+            ss = lax.all_gather(s, axis_name)    # one f32 scale per member
+            mean = jnp.sum(
+                qs.astype(jnp.float32)
+                * ss.reshape((n,) + (1,) * t.ndim), axis=0
+            ) / n
+        else:
+            k = max(1, int(round(topk_frac * t.size)))
+            vals, idx = sparsify_topk(target, k)
+            sent = densify_topk(vals, idx, t.size).reshape(t.shape)
+            all_vals = lax.all_gather(vals, axis_name)   # (n, k) f32
+            all_idx = lax.all_gather(idx, axis_name)     # (n, k) int32
+            mean = (
+                jnp.zeros((t.size,), jnp.float32)
+                .at[all_idx.ravel()]
+                .add(all_vals.ravel())
+                .reshape(t.shape)
+            ) / n
         new_e = None
         if e is not None:
-            new_e = (
-                target.astype(jnp.float32) - dequantize_tensor_int8(q, s)
-            )[None]
-        qs = lax.all_gather(q, axis_name)        # int8 on the wire
-        ss = lax.all_gather(s, axis_name)        # one f32 scale per member
-        mean = jnp.sum(
-            qs.astype(jnp.float32) * ss.reshape((n,) + (1,) * t.ndim), axis=0
-        ) / n
+            new_e = (target.astype(jnp.float32) - sent)[None]
         return mean.astype(t.dtype), new_e
 
     if ef is None:

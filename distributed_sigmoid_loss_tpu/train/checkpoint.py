@@ -24,11 +24,26 @@ import orbax.checkpoint as ocp
 __all__ = ["save_checkpoint", "restore_checkpoint", "AsyncSaver"]
 
 
+def _strip_ef(state: Any) -> Any:
+    """Drop the error-feedback residual before writing (compressed DCN sync,
+    train/compressed_step.py). ef is ONE step's quantization carry: resetting
+    it to zero on resume defers at most one step of sub-quantization signal,
+    while persisting it would grow every checkpoint by a param-sized tree per
+    slice AND make compressed-run checkpoints structurally incompatible with
+    eval and with uncompressed resume (orbax restore is structure-strict).
+    Checkpoints therefore always have ef=None — one portable structure."""
+    if getattr(state, "ef", None) is not None:
+        return state.replace(ef=None)
+    return state
+
+
 def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
-    """Save a train state (or any pytree of arrays) to ``path`` (a directory)."""
+    """Save a train state (or any pytree of arrays) to ``path`` (a directory).
+
+    The ``ef`` subtree is never written — see :func:`_strip_ef`."""
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state, force=force)
+        ckptr.save(path, _strip_ef(state), force=force)
 
 
 class AsyncSaver:
@@ -47,7 +62,8 @@ class AsyncSaver:
 
     def save(self, path: str, state: Any, *, force: bool = True) -> None:
         self._ckptr.save(
-            os.path.abspath(path), args=ocp.args.StandardSave(state), force=force
+            os.path.abspath(path), args=ocp.args.StandardSave(_strip_ef(state)),
+            force=force,
         )
 
     def wait(self) -> None:

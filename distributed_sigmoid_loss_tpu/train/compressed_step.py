@@ -66,6 +66,8 @@ def make_compressed_train_step(
     dcn_axis: str = "dcn",
     error_feedback: bool = True,
     zero1: bool = False,
+    compression: str = "int8",
+    topk_frac: float = 0.01,
 ):
     """Build ``(state, batch) -> (state, metrics)`` with int8 DCN grad sync.
 
@@ -73,8 +75,18 @@ def make_compressed_train_step(
     With ``error_feedback=True`` create the state via
     :func:`with_error_feedback` (the step raises otherwise). Metrics gain
     ``ef_norm`` — the global norm of the carried residual, a live view of how
-    much signal the int8 wire deferred (should stay ~flat, not grow).
+    much signal the compressed wire deferred (should stay ~flat, not grow).
+
+    ``compression``: ``"int8"`` (4x fewer DCN bytes) or ``"topk"`` (keep the
+    ``topk_frac`` largest-|.| entries per tensor, ~50x fewer at 1% — needs
+    error feedback; the step refuses topk without it).
     """
+    if compression == "topk" and not error_feedback:
+        raise ValueError(
+            "compression='topk' without error feedback silently drops "
+            f"{(1 - topk_frac):.0%} of every gradient as pure bias; create "
+            "the state with with_error_feedback(state, mesh)"
+        )
     if loss_cfg.variant != "all_gather":
         raise ValueError(
             "compressed DCN sync supports variant='all_gather' only (the ring "
@@ -107,7 +119,9 @@ def make_compressed_train_step(
         # link: f32 psum-mean on ICI; compressed_axis_mean is itself a MEAN
         # over dcn, so the two hops together divide by the full world size.
         grads = jax.tree.map(lambda t: lax.psum(t, axis) / n_dp, grads)
-        grads, new_ef = compressed_axis_mean(grads, dcn_axis, ef)
+        grads, new_ef = compressed_axis_mean(
+            grads, dcn_axis, ef, method=compression, topk_frac=topk_frac
+        )
         loss = lax.pmean(lax.pmean(ell, axis), dcn_axis)
         return loss, lp, grads, new_ef
 

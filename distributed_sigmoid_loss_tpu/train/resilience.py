@@ -182,6 +182,14 @@ def restore_latest(root: str, target: Any) -> tuple[Any, int] | None:
     step = latest_step(root)
     if step is None:
         return None
+    if getattr(target, "ef", None) is not None:
+        # Checkpoints never carry the error-feedback residual (see
+        # checkpoint._strip_ef); restore the portable structure and restart
+        # EF from the target's (zeroed) tree.
+        bare = restore_checkpoint(
+            _step_dir(root, step), target.replace(ef=None)
+        )
+        return bare.replace(ef=target.ef), step
     return restore_checkpoint(_step_dir(root, step), target), step
 
 

@@ -230,6 +230,108 @@ def test_cli_train_compressed_smoke():
     assert all("ef_norm" in r and "loss" in r for r in recs)
 
 
+def test_topk_sparsify_roundtrip():
+    from distributed_sigmoid_loss_tpu.parallel.compression import (
+        densify_topk,
+        sparsify_topk,
+    )
+
+    t = jnp.asarray([0.1, -3.0, 0.02, 2.0, -0.5, 0.0], jnp.float32)
+    vals, idx = sparsify_topk(t, 2)
+    dense = densify_topk(vals, idx, t.size)
+    np.testing.assert_allclose(
+        dense, [0.0, -3.0, 0.0, 2.0, 0.0, 0.0], atol=1e-7
+    )
+
+
+def test_topk_mean_with_full_k_is_exact():
+    """topk at k=100% must reduce to the exact mean (the sparsification is
+    lossless when nothing is dropped)."""
+    mesh = hybrid_mesh()
+    rng = np.random.default_rng(5)
+    g = jnp.asarray(rng.standard_normal((2, 16, 8)), jnp.float32)
+
+    def body(t):
+        mean, _ = compressed_axis_mean(
+            {"g": jnp.squeeze(t, 0)}, "dcn", None, method="topk",
+            topk_frac=1.0,
+        )
+        return mean["g"]
+
+    out = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=(P("dcn"),), out_specs=P(),
+                      check_vma=False)
+    )(g)
+    np.testing.assert_allclose(out, jnp.mean(g, axis=0), rtol=1e-6)
+
+
+def test_topk_error_feedback_telescopes():
+    """At 10% keep-rate the dropped 90% must ride EF into later steps: the
+    K-step sum tracks the exact sum far better than the 90%-dropped bias."""
+    mesh = hybrid_mesh()
+    rng = np.random.default_rng(6)
+    K = 30
+    gs = jnp.asarray(rng.standard_normal((K, 2, 8, 4)) * 0.01, jnp.float32)
+
+    def body(seq, ef):
+        def one(e, t):
+            mean, e2 = compressed_axis_mean(
+                {"g": jnp.squeeze(t, 0)}, "dcn", {"g": e}, method="topk",
+                topk_frac=0.1,
+            )
+            return e2["g"], mean["g"]
+
+        ef2, means = lax.scan(one, ef["g"], seq)
+        return jnp.sum(means, axis=0), {"g": ef2}
+
+    summed, _ = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "dcn"), P("dcn")),
+            out_specs=(P(), P("dcn")),
+            check_vma=False,
+        )
+    )(gs, init_error_feedback({"g": jnp.zeros((8, 4))}, 2))
+    exact = jnp.sum(jnp.mean(gs, axis=1), axis=0)
+    err = float(jnp.max(jnp.abs(summed - exact)))
+    # Without EF, dropping 90% of ~0.01-scale entries for 30 steps leaves
+    # O(30 * 0.01) = 0.3 of unsent mass; with EF everything unsent is at most
+    # one step's carry (~0.03).
+    assert err < 0.05, err
+
+
+def test_topk_step_descends_and_requires_ef():
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_compressed_train_step,
+        with_error_feedback,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig
+
+    mesh = hybrid_mesh()
+    model, batch = _tiny_model_and_batch()
+    with pytest.raises(ValueError, match="topk"):
+        make_compressed_train_step(
+            model, mesh, LossConfig(variant="all_gather"),
+            error_feedback=False, compression="topk",
+        )
+    state = with_error_feedback(
+        create_train_state(jax.random.key(0), model, optax.sgd(1e-2), batch,
+                           mesh),
+        mesh,
+    )
+    step, shardings = make_compressed_train_step(
+        model, mesh, LossConfig(variant="all_gather"), compression="topk",
+        topk_frac=0.05,
+    )
+    b = jax.device_put(batch, shardings)
+    losses = []
+    for _ in range(5):
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
 def test_compressed_step_without_error_feedback():
     """error_feedback=False: no ef tree in flight, no ef_norm metric, still
     descends (one-shot int8 noise only)."""
@@ -245,6 +347,49 @@ def test_compressed_step_without_error_feedback():
         losses.append(float(mc["loss"]))
     assert "ef_norm" not in mc
     assert losses[-1] < losses[0], losses
+
+
+def test_compressed_checkpoint_is_mode_portable(tmp_path):
+    """Checkpoints from compressed runs carry NO ef subtree: eval restores
+    them, an uncompressed train resumes them, and a compressed resume
+    restarts EF from zero. One checkpoint structure for every mode."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    ck = str(tmp_path / "ck")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "distributed_sigmoid_loss_tpu", *extra],
+            capture_output=True, text=True, timeout=240, env=env, cwd=repo,
+        )
+
+    # Compressed run writes checkpoints.
+    p1 = run("train", "--cpu-devices", "8", "--tiny", "--steps", "2",
+             "--batch", "16", "--dcn-slices", "2", "--grad-compression",
+             "int8", "--ckpt-dir", ck, "--ckpt-every", "2")
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    # Eval restores the compressed checkpoint (the target has ef=None).
+    p2 = run("eval", "--cpu-devices", "8", "--tiny", "--batch", "16",
+             "--ckpt-dir", ck, "--classes", "4")
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    # Compressed resume: restores params, restarts EF at zero.
+    p3 = run("train", "--cpu-devices", "8", "--tiny", "--steps", "4",
+             "--batch", "16", "--dcn-slices", "2", "--grad-compression",
+             "int8", "--ckpt-dir", ck, "--ckpt-every", "10")
+    assert p3.returncode == 0, p3.stderr[-2000:]
+    recs = [json.loads(l) for l in p3.stdout.splitlines() if l.startswith("{")]
+    assert recs and recs[0]["step"] == 3, recs[:1]
+    # Uncompressed resume of the same checkpoint also restores cleanly.
+    p4 = run("train", "--cpu-devices", "8", "--tiny", "--steps", "4",
+             "--batch", "16", "--ckpt-dir", ck, "--ckpt-every", "10")
+    assert p4.returncode == 0, p4.stderr[-2000:]
 
 
 def test_compressed_requires_allgather_variant():
