@@ -328,6 +328,10 @@ def cmd_train(args) -> int:
         print("--topk-frac without --grad-compression topk is a silent "
               "no-op", file=sys.stderr)
         return 2
+    if args.topk_exact and args.grad_compression != "topk":
+        print("--topk-exact without --grad-compression topk is a silent "
+              "no-op", file=sys.stderr)
+        return 2
     mesh, mesh_err = _make_training_mesh(args)
     if mesh_err:
         print(mesh_err, file=sys.stderr)
@@ -514,6 +518,7 @@ def cmd_train(args) -> int:
             zero1=args.zero1,
             compression=args.grad_compression,
             topk_frac=args.topk_frac,
+            topk_approximate=not args.topk_exact,
         )
     else:
         step_fn, shardings = make_train_step(
@@ -1137,6 +1142,10 @@ def main(argv=None) -> int:
     tr.add_argument("--topk-frac", type=float, default=0.01, metavar="F",
                     help="fraction of entries kept per tensor under "
                          "--grad-compression topk")
+    tr.add_argument("--topk-exact", action="store_true",
+                    help="exact lax.top_k selection instead of the default "
+                         "approx_max_k (4x slower on TPU at gradient scale "
+                         "-- docs/PERF.md; use for bit-reproducibility)")
     tr.add_argument("--ema-decay", type=float, default=None,
                     help="maintain an EMA of the params in the train state "
                          "(e.g. 0.9999, warmed up)")

@@ -62,7 +62,9 @@ def dequantize_tensor_int8(q: jax.Array, scale: jax.Array) -> jax.Array:
     return q.astype(jnp.float32) * scale
 
 
-def sparsify_topk(t: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+def sparsify_topk(
+    t: jax.Array, k: int, approximate: bool = True
+) -> tuple[jax.Array, jax.Array]:
     """Top-``k``-by-magnitude sparsification: ``(values, flat_indices)``.
 
     The OTHER standard wire format for gradient compression (deep gradient
@@ -70,10 +72,24 @@ def sparsify_topk(t: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     error feedback carries the rest. Wire cost 8 bytes/kept entry (f32 value +
     int32 index) vs 4 bytes/entry dense — a win for k/size < ~1/2, typically
     run at 1%.
+
+    ``approximate=True`` (default) selects via ``lax.approx_max_k`` — the
+    TPU-optimized bucketed top-k. Measured on chip at b16 gradient scale
+    (docs/PERF.md): exact ``lax.top_k`` costs 227 ms/step (61% of a train
+    step — compute-prohibitive), approx 55 ms at 98.5% recall. Bucketed
+    selection can occasionally miss entries ABOVE the k-th magnitude (bucket
+    collisions keep only the bucket max), so approximation is only sound
+    together with error feedback: whatever is missed — large or small —
+    rides the residual into the next step. Use it with EF (the compressed
+    train step already requires EF for topk).
     """
     flat = t.astype(jnp.float32).ravel()
-    _, idx = lax.top_k(jnp.abs(flat), k)
-    return flat[idx], idx.astype(jnp.int32)
+    if approximate:
+        _, idx = lax.approx_max_k(jnp.abs(flat), k)
+    else:
+        _, idx = lax.top_k(jnp.abs(flat), k)
+    idx = idx.astype(jnp.int32)
+    return flat[idx], idx
 
 
 def densify_topk(values: jax.Array, idx: jax.Array, size: int) -> jax.Array:
@@ -94,7 +110,8 @@ def init_error_feedback(params, n_slices: int):
 
 
 def compressed_axis_mean(tree, axis_name: str, ef=None, method: str = "int8",
-                         topk_frac: float = 0.01):
+                         topk_frac: float = 0.01,
+                         topk_approximate: bool = True):
     """Mean of ``tree`` over the (slow) ``axis_name`` with a compressed wire.
 
     Must run inside ``shard_map`` manual over ``axis_name``. ``tree`` holds
@@ -106,6 +123,8 @@ def compressed_axis_mean(tree, axis_name: str, ef=None, method: str = "int8",
     bytes) or ``"topk"`` (top-``topk_frac``-by-magnitude sparsification,
     8 bytes/kept entry — ~50x fewer at the standard 1%; run it WITH error
     feedback, the dropped 99% is pure bias otherwise).
+    ``topk_approximate=False`` switches the topk selection to exact
+    ``lax.top_k`` (4x slower on TPU at gradient scale, docs/PERF.md).
 
     Returns ``(mean_tree, new_ef)`` — ``mean_tree`` replicated over the axis,
     ``new_ef`` the residual ``(t + ef) - decompress(compress(t + ef))`` to
@@ -128,7 +147,7 @@ def compressed_axis_mean(tree, axis_name: str, ef=None, method: str = "int8",
             ) / n
         else:
             k = max(1, int(round(topk_frac * t.size)))
-            vals, idx = sparsify_topk(target, k)
+            vals, idx = sparsify_topk(target, k, approximate=topk_approximate)
             sent = densify_topk(vals, idx, t.size).reshape(t.shape)
             all_vals = lax.all_gather(vals, axis_name)   # (n, k) f32
             all_idx = lax.all_gather(idx, axis_name)     # (n, k) int32

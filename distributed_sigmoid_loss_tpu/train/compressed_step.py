@@ -68,6 +68,7 @@ def make_compressed_train_step(
     zero1: bool = False,
     compression: str = "int8",
     topk_frac: float = 0.01,
+    topk_approximate: bool = True,
 ):
     """Build ``(state, batch) -> (state, metrics)`` with int8 DCN grad sync.
 
@@ -80,6 +81,8 @@ def make_compressed_train_step(
     ``compression``: ``"int8"`` (4x fewer DCN bytes) or ``"topk"`` (keep the
     ``topk_frac`` largest-|.| entries per tensor, ~50x fewer at 1% — needs
     error feedback; the step refuses topk without it).
+    ``topk_approximate=False`` uses exact ``lax.top_k`` selection (CLI:
+    ``--topk-exact``) — 4x slower on TPU, for bit-reproducibility needs.
     """
     if compression == "topk" and not error_feedback:
         raise ValueError(
@@ -120,7 +123,8 @@ def make_compressed_train_step(
         # over dcn, so the two hops together divide by the full world size.
         grads = jax.tree.map(lambda t: lax.psum(t, axis) / n_dp, grads)
         grads, new_ef = compressed_axis_mean(
-            grads, dcn_axis, ef, method=compression, topk_frac=topk_frac
+            grads, dcn_axis, ef, method=compression, topk_frac=topk_frac,
+            topk_approximate=topk_approximate,
         )
         loss = lax.pmean(lax.pmean(ell, axis), dcn_axis)
         return loss, lp, grads, new_ef
