@@ -28,10 +28,12 @@ Composability: ``gpipe`` is manual over ``pp`` only (``axis_names={"pp"}``), so
 dp/tp axes of the same mesh keep working through GSPMD — batch stays dp-sharded,
 stage weights stay tp-sharded, and the pipeline only moves activations.
 
-Scope note: microbatch inputs/outputs are replicated over ``pp`` (each stage holds
-the (M, ...) buffer); at tower-activation sizes this costs M·|x| HBM per chip and
-keeps the schedule a pure scan. Streaming stage-0-resident inputs is a further
-memory optimization, not a semantics change.
+Memory: by default microbatch inputs/outputs are replicated over ``pp`` (each
+stage holds the (M, ...) buffer — M·|x| HBM per chip). ``gpipe(stream_io=True)``
+removes that: the buffers block-shard over ``pp`` and a ppermute conveyor
+delivers each microbatch to stage 0 exactly when the schedule consumes it (and
+ships outputs back to their home shard), cutting the buffer cost S-fold at zero
+extra ticks. The pp towers use it whenever S | M (parallel/pp_towers.py).
 """
 
 from __future__ import annotations
@@ -103,6 +105,7 @@ def gpipe(
     mesh: Mesh,
     axis_name: str = pipeline_axis,
     checkpoint_stages: bool = False,
+    stream_io: bool = False,
 ) -> jax.Array:
     """Run ``microbatches`` through ``num_stages`` pipelined stages; returns outputs.
 
@@ -116,12 +119,32 @@ def gpipe(
         throughput-wise M ≫ S amortizes the (S-1)-tick bubble.
       checkpoint_stages: rematerialize each stage call in the backward pipeline
         (GPipe's standard activation-memory trade).
+      stream_io: shard the microbatch buffers over ``pp`` instead of
+        replicating them (requires ``S | M``) — per-stage HBM for inputs AND
+        outputs drops S-fold, from ``2·M·|x|`` to ``2·(M/S)·|x|`` plus two
+        in-flight slots. Mechanism: the M dim's natural block sharding makes
+        stage ``p`` the HOME of microbatches ``[p·M/S, (p+1)·M/S)``; an input
+        conveyor moves each microbatch one ``ppermute`` hop per tick toward
+        stage 0, timed to arrive exactly when the schedule consumes it
+        (microbatch ``m`` departs home ``p=⌊mS/M⌋`` at tick ``m-p``), and a
+        mirrored output conveyor carries finished microbatches from the last
+        stage back to their home shard (``y_m`` arrives at tick
+        ``m+2(S-1)-p`` — the last arrival lands on the existing final tick,
+        so streaming costs ZERO extra ticks, just 2 activation-sized hops per
+        tick riding the same ICI links as the stage boundary).
 
     Returns:
-      ``(M, mb, ...)`` outputs of the full S-stage stack, replicated over ``pp``.
+      ``(M, mb, ...)`` outputs of the full S-stage stack — replicated over
+      ``pp`` normally, sharded over ``pp`` on the M dim under ``stream_io``.
     """
     num_stages = mesh.shape[axis_name]
     num_micro = microbatches.shape[0]
+    if stream_io and num_micro % num_stages:
+        raise ValueError(
+            f"stream_io requires stages | microbatches, got S={num_stages}, "
+            f"M={num_micro} (the M dim block-shards over pp as the home "
+            f"layout; pad M or use stream_io=False)"
+        )
     if checkpoint_stages:
         stage_fn = jax.checkpoint(stage_fn)
 
@@ -166,6 +189,79 @@ def gpipe(
             jnp.where(stage == num_stages - 1, out, jnp.zeros_like(out)), axis_name
         )
 
+    def device_fn_streamed(params, xs_home):
+        # xs_home: (M/S, mb, ...) — this stage's home block of microbatches.
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        stage = lax.axis_index(axis_name)
+        s, per = num_stages, num_micro // num_stages
+        act0 = jnp.zeros_like(xs_home[0])
+        # conv: before tick t, stage p holds microbatch t+p iff p <= home(t+p)
+        # (in transit toward stage 0, one hop per tick). At t=0 stage p holds
+        # microbatch p iff p IS its home (p == floor(p*S/M)) — only stage 0
+        # for M > S, but EVERY stage when M == S (each block is one microbatch
+        # whose transit starts immediately).
+        conv0 = jnp.where(
+            stage == jnp.clip(stage * s // num_micro, 0, s - 1),
+            xs_home[0],
+            jnp.zeros_like(xs_home[0]),
+        )
+        oconv0 = jnp.zeros_like(xs_home[0])
+        out0 = jnp.zeros_like(xs_home)
+
+        def tick(carry, t):
+            act, conv, oconv, out_local = carry
+            received = ring_shift_right(act, axis_name)
+            x_in = jnp.where(stage == 0, conv, received)
+            y = stage_fn(params, x_in)
+
+            # Input conveyor for tick t+1: inject from home storage when the
+            # next microbatch's transit starts here, else receive from the
+            # stage above (one hop toward stage 0 per tick).
+            m_next = t + 1 + stage
+            inject = stage == jnp.clip(m_next * s // num_micro, 0, s - 1)
+            j_in = jnp.clip(m_next - stage * per, 0, per - 1)
+            conv = jnp.where(
+                inject,
+                lax.dynamic_index_in_dim(xs_home, j_in, 0, keepdims=False),
+                ring_shift_left(conv, axis_name),
+            )
+
+            # Output conveyor: the last stage inserts the microbatch it just
+            # finished; everyone else passes their slot one hop toward its
+            # home. After this tick, stage p holds y of m = t - 2(S-1) + p.
+            fresh = (stage == s - 1) & (t >= s - 1)
+            oconv = jnp.where(
+                fresh, y.astype(oconv.dtype), ring_shift_left(oconv, axis_name)
+            )
+            m_here = t - 2 * (s - 1) + stage
+            arrived = (
+                (m_here >= 0)
+                & (m_here < num_micro)
+                & (stage == jnp.clip(m_here * s // num_micro, 0, s - 1))
+            )
+            j_out = jnp.clip(m_here - stage * per, 0, per - 1)
+            out_local = jnp.where(
+                arrived,
+                lax.dynamic_update_index_in_dim(out_local, oconv, j_out, 0),
+                out_local,
+            )
+            return (y, conv, oconv, out_local), None
+
+        (_, _, _, out_local), _ = lax.scan(
+            tick,
+            (act0, conv0, oconv0, out0),
+            jnp.arange(num_micro + num_stages - 1),
+        )
+        return out_local
+
+    if stream_io:
+        return jax.shard_map(
+            device_fn_streamed,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name)),
+            out_specs=P(axis_name),
+            axis_names={axis_name},
+        )(stage_params, microbatches)
     return jax.shard_map(
         device_fn,
         mesh=mesh,

@@ -122,7 +122,13 @@ def _pipelined_blocks(
     # Row order is preserved: split -> pipeline -> exact-inverse merge, so the
     # loss's positive-pair diagonal survives the microbatching.
     xs = microbatch_split(x, num_microbatches, mesh, what="pp_microbatches")
-    ys = gpipe(stage_fn, stage_params, xs, mesh=mesh, axis_name=axis_name)
+    # stream_io whenever the schedule allows (S | M — true for the default
+    # M = 2S): the (M, ...) in/out buffers shard over pp instead of
+    # replicating, cutting per-stage activation-buffer HBM S-fold.
+    ys = gpipe(
+        stage_fn, stage_params, xs, mesh=mesh, axis_name=axis_name,
+        stream_io=num_microbatches % num_stages == 0,
+    )
     return microbatch_merge(ys, mesh)
 
 

@@ -57,7 +57,12 @@ def tiny_batch(global_b, cfg, seed=0):
     }
 
 
-@pytest.mark.parametrize("dp,pp,micro", [(2, 4, 2), (1, 2, 3)])
+@pytest.mark.parametrize(
+    "dp,pp,micro",
+    # micro=2/3: replicated-buffer path (S does not divide M);
+    # micro=8/4: the streamed conveyor path (gpipe stream_io).
+    [(2, 4, 2), (1, 2, 3), (2, 4, 4), (1, 2, 4)],
+)
 def test_pp_forward_matches_plain(dp, pp, micro):
     cfg = pp_config()
     model = SigLIP(cfg)
