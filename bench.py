@@ -1005,6 +1005,26 @@ def main():
     # post-SPMD-partitioning PER-DEVICE module (includes remat recompute); it may
     # be unavailable on some PJRT backends.
     compiled = step.lower(state, batch).compile()
+    # Peak device memory of the compiled step (XLA's own accounting):
+    # arguments+outputs+temps+generated code. The number that tells you how
+    # far the config sits from the HBM wall before you hit it mid-run.
+    peak_hbm_gb = None
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            peak_hbm_gb = round(
+                (
+                    mem.argument_size_in_bytes
+                    + mem.output_size_in_bytes
+                    + mem.temp_size_in_bytes
+                    + mem.generated_code_size_in_bytes
+                    - mem.alias_size_in_bytes
+                )
+                / 2**30,  # GiB, matching the --context bench's peak_hbm_gb
+                2,
+            )
+    except Exception:
+        pass
     hw_flops_per_step_per_dev = None
     if spc == 1:
         # Only meaningful unfused: HloCostAnalysis counts a while-loop body
@@ -1076,6 +1096,8 @@ def main():
         "final_loss": round(final_loss, 4),
         "model_tflops_per_sec_per_chip": round(achieved_model_tflops, 1),
     }
+    if peak_hbm_gb is not None:
+        record["peak_hbm_gb"] = peak_hbm_gb
     # Executed-FLOPs utilization from XLA's cost model — only when self-consistent:
     # executed FLOPs include remat recompute, so they can never be below the model
     # FLOPs. Some PJRT plugins (observed: axon) report a module "flops" an order of
