@@ -33,7 +33,9 @@ stage holds the (M, ...) buffer — M·|x| HBM per chip). ``gpipe(stream_io=True
 removes that: the buffers block-shard over ``pp`` and a ppermute conveyor
 delivers each microbatch to stage 0 exactly when the schedule consumes it (and
 ships outputs back to their home shard), cutting the buffer cost S-fold at zero
-extra ticks. The pp towers use it whenever S | M (parallel/pp_towers.py).
+extra ticks. The pp towers use it whenever S | M (parallel/pp_towers.py);
+``one_f_one_b(stream_inputs=True)`` applies the same input conveyor to the
+1F1B schedule (whose outputs are already O(params)).
 """
 
 from __future__ import annotations
@@ -95,6 +97,42 @@ def make_layer_stage_fn(layer_apply: Callable[[Any, jax.Array], jax.Array]) -> C
         return x
 
     return stage_fn
+
+
+def _input_conveyor(xs_home, stage, axis_name, num_stages, num_micro):
+    """The just-in-time input conveyor shared by ``gpipe(stream_io=True)`` and
+    ``one_f_one_b(stream_inputs=True)`` (both consume microbatch ``t`` at
+    stage 0 on tick ``t``).
+
+    ``xs_home``: this stage's pp-sharded ``(M/S, ...)`` home block. Returns
+    ``(conv0, advance)`` where ``conv0`` is the conveyor slot before tick 0
+    and ``advance(conv, t)`` produces the slot for tick ``t+1``: inject from
+    home storage when the next microbatch's transit starts here
+    (``stage == home(t+1+stage)``, home(m) = ⌊mS/M⌋), else receive one hop
+    from the stage above (``ring_shift_left``). Invariant: before tick t,
+    stage p holds microbatch ``t+p`` iff ``p <= home(t+p)`` (in transit
+    toward stage 0, one hop per tick). At t=0 that is microbatch ``p`` iff
+    ``p`` IS its home — only stage 0 for M > S, every stage when M == S.
+    """
+    per = num_micro // num_stages
+
+    def home(m):
+        return jnp.clip(m * num_stages // num_micro, 0, num_stages - 1)
+
+    conv0 = jnp.where(
+        stage == home(stage), xs_home[0], jnp.zeros_like(xs_home[0])
+    )
+
+    def advance(conv, t):
+        m_next = t + 1 + stage
+        j_in = jnp.clip(m_next - stage * per, 0, per - 1)
+        return jnp.where(
+            stage == home(m_next),
+            lax.dynamic_index_in_dim(xs_home, j_in, 0, keepdims=False),
+            ring_shift_left(conv, axis_name),
+        )
+
+    return conv0, advance
 
 
 def gpipe(
@@ -195,15 +233,8 @@ def gpipe(
         stage = lax.axis_index(axis_name)
         s, per = num_stages, num_micro // num_stages
         act0 = jnp.zeros_like(xs_home[0])
-        # conv: before tick t, stage p holds microbatch t+p iff p <= home(t+p)
-        # (in transit toward stage 0, one hop per tick). At t=0 stage p holds
-        # microbatch p iff p IS its home (p == floor(p*S/M)) — only stage 0
-        # for M > S, but EVERY stage when M == S (each block is one microbatch
-        # whose transit starts immediately).
-        conv0 = jnp.where(
-            stage == jnp.clip(stage * s // num_micro, 0, s - 1),
-            xs_home[0],
-            jnp.zeros_like(xs_home[0]),
+        conv0, advance_conv = _input_conveyor(
+            xs_home, stage, axis_name, num_stages, num_micro
         )
         oconv0 = jnp.zeros_like(xs_home[0])
         out0 = jnp.zeros_like(xs_home)
@@ -214,17 +245,7 @@ def gpipe(
             x_in = jnp.where(stage == 0, conv, received)
             y = stage_fn(params, x_in)
 
-            # Input conveyor for tick t+1: inject from home storage when the
-            # next microbatch's transit starts here, else receive from the
-            # stage above (one hop toward stage 0 per tick).
-            m_next = t + 1 + stage
-            inject = stage == jnp.clip(m_next * s // num_micro, 0, s - 1)
-            j_in = jnp.clip(m_next - stage * per, 0, per - 1)
-            conv = jnp.where(
-                inject,
-                lax.dynamic_index_in_dim(xs_home, j_in, 0, keepdims=False),
-                ring_shift_left(conv, axis_name),
-            )
+            conv = advance_conv(conv, t)
 
             # Output conveyor: the last stage inserts the microbatch it just
             # finished; everyone else passes their slot one hop toward its
@@ -279,6 +300,7 @@ def one_f_one_b(
     *,
     mesh: Mesh,
     axis_name: str = pipeline_axis,
+    stream_inputs: bool = False,
 ) -> tuple[jax.Array, Any]:
     """1F1B pipeline training step: ``(mean loss, stage-param grads)``.
 
@@ -313,6 +335,13 @@ def one_f_one_b(
       loss_fn: ``y -> scalar`` applied to each LAST-stage output; the returned
         loss (and grads) are the mean over the M microbatches.
 
+    ``stream_inputs=True`` shards the microbatch buffer over ``pp`` instead
+    of replicating it (requires ``S | M``), using the same just-in-time
+    ppermute conveyor as ``gpipe(stream_io=True)`` — the forward sub-tick's
+    stage-0 feed timing is identical (microbatch ``u`` consumed at tick
+    ``u``). Outputs need no conveyor here: they are already the O(1) loss
+    accumulator and O(params) grads.
+
     Returns:
       ``(loss, grads)``: scalar mean loss (replicated) and a grads pytree
       shaped/sharded like ``stage_params``.
@@ -321,11 +350,17 @@ def one_f_one_b(
     num_micro = microbatches.shape[0]
     stash_depth = 2 * num_stages - 1
     total_ticks = num_micro + 2 * (num_stages - 1)
+    if stream_inputs and num_micro % num_stages:
+        raise ValueError(
+            f"stream_inputs requires stages | microbatches, got "
+            f"S={num_stages}, M={num_micro}"
+        )
 
     def device_fn(params, xs):
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
         stage = lax.axis_index(axis_name)
-        xs = pvary(xs, axis_name)
+        if not stream_inputs:
+            xs = pvary(xs, axis_name)
         mb_shape = xs.shape[1:]
 
         # Every carry starts device-varying (pvary): the body mixes in
@@ -336,17 +371,28 @@ def one_f_one_b(
         # (zeros_like params is already varying — params arrive pp-sharded.)
         gacc0 = jax.tree.map(jnp.zeros_like, params)
         loss0 = pvary(jnp.zeros((), jnp.float32), axis_name)
+        # Input conveyor (stream_inputs): shared with gpipe's streamed path.
+        if stream_inputs:
+            conv0, advance_conv = _input_conveyor(
+                xs, stage, axis_name, num_stages, num_micro
+            )
+        else:
+            conv0 = jnp.zeros((), xs.dtype)  # placeholder carry, never read
 
         def tick(carry, u):
-            act, cot, stash, gacc, loss_acc = carry
+            act, cot, stash, conv, gacc, loss_acc = carry
 
             # ---- forward sub-tick: mb m_f = u - stage ----------------------
             m_f = u - stage
             f_valid = (m_f >= 0) & (m_f < num_micro)
             received = ring_shift_right(act, axis_name)
-            feed = lax.dynamic_index_in_dim(
-                xs, jnp.clip(m_f, 0, num_micro - 1), 0, keepdims=False
-            )
+            if stream_inputs:
+                feed = conv
+                conv = advance_conv(conv, u)
+            else:
+                feed = lax.dynamic_index_in_dim(
+                    xs, jnp.clip(m_f, 0, num_micro - 1), 0, keepdims=False
+                )
             x_in = jnp.where(stage == 0, feed, received)
             y = stage_fn(params, x_in)
             act_next = y
@@ -398,10 +444,11 @@ def one_f_one_b(
                 gacc, gparams,
             )
             cot_next = jnp.where(b_valid, dx, jnp.zeros_like(dx))
-            return (act_next, cot_next, stash, gacc, loss_acc), None
+            return (act_next, cot_next, stash, conv, gacc, loss_acc), None
 
-        (_, _, _, gacc, loss_acc), _ = lax.scan(
-            tick, (act0, cot0, stash0, gacc0, loss0), jnp.arange(total_ticks)
+        (_, _, _, _, gacc, loss_acc), _ = lax.scan(
+            tick, (act0, cot0, stash0, conv0, gacc0, loss0),
+            jnp.arange(total_ticks),
         )
         # Mean over microbatches; the loss lives on the last stage only — the
         # masked psum replicates it (same pattern as gpipe's output collect).
@@ -417,7 +464,7 @@ def one_f_one_b(
     return jax.shard_map(
         device_fn,
         mesh=mesh,
-        in_specs=(P(axis_name), P()),
+        in_specs=(P(axis_name), P(axis_name) if stream_inputs else P()),
         out_specs=(P(), P(axis_name)),
         axis_names={axis_name},
     )(stage_params, microbatches)

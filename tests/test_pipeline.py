@@ -249,6 +249,37 @@ def test_one_f_one_b_matches_sequential(num_stages, num_micro):
     )
 
 
+@pytest.mark.parametrize("num_stages,num_micro", [(4, 8), (4, 4), (2, 8)])
+def test_one_f_one_b_stream_inputs_matches_sequential(num_stages, num_micro):
+    """stream_inputs feeds the forward sub-tick from the pp-sharded conveyor;
+    loss and grads must equal plain autodiff of the sequential stack."""
+    from distributed_sigmoid_loss_tpu.parallel.pipeline import one_f_one_b
+
+    params, xs = _mlp_setup(num_stages, num_micro)
+    mesh = make_mesh(num_stages, "pp")
+
+    def loss_fn(y):
+        return jnp.sum(y**2)
+
+    def seq_loss(p):
+        return jnp.mean(jax.vmap(loss_fn)(_sequential(p, xs)))
+
+    want_loss, want_grads = jax.value_and_grad(seq_loss)(params)
+    got_loss, got_grads = jax.jit(
+        lambda p, x: one_f_one_b(
+            _stage, p, x, loss_fn, mesh=mesh, stream_inputs=True
+        )
+    )(params, xs)
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(got_grads), np.asarray(want_grads), rtol=1e-5, atol=1e-6
+    )
+    with pytest.raises(ValueError, match="stream_inputs requires"):
+        one_f_one_b(
+            _stage, params, xs[:3], loss_fn, mesh=mesh, stream_inputs=True
+        )
+
+
 def test_one_f_one_b_matches_gpipe_autodiff():
     """Cross-implementation oracle (the compare_naive_vs_rw pattern): the manual
     1F1B backward equals autodiff through the gpipe forward."""
