@@ -103,7 +103,8 @@ def emit_backend_error(args, error: str) -> None:
         metric, unit = "train_step_breakdown_ms", "ms"
     else:
         metric, unit = (
-            f"siglip_vit{args.model}_train_pairs_per_sec_per_chip",
+            f"siglip_vit{args.model}_train_pairs_per_sec_per_chip"
+            f"{getattr(args, 'metric_suffix', '')}",
             "pairs/s/chip",
         )
     print(json.dumps({
@@ -758,6 +759,13 @@ def main():
     ap.add_argument("--mu-bf16", action="store_true",
                     help="bf16 Adam first moment (halves that buffer; the cheap "
                          "end of the optimizer-memory ladder before ZeRO-1)")
+    ap.add_argument("--accum-bf16", action="store_true",
+                    help="bf16 gradient accumulator under --accum (adds stay "
+                         "f32; halves the accumulator's per-microstep HBM "
+                         "read+write and its resident footprint)")
+    ap.add_argument("--metric-suffix", default="",
+                    help="appended to the JSON metric name (the no-args driver "
+                         "run tags its 32k-equivalent record _32k_equiv)")
     ap.add_argument("--moe", type=int, default=0, metavar="E",
                     help="mixture-of-experts towers with E experts per block "
                          "(replicated on 1 chip; shard over ep on a pod)")
@@ -835,7 +843,7 @@ def main():
         # --text-attn-impl, --scan-layers, --moe/--moe-k/--moe-group-size.
         unsupported = {
             "--accum": args.accum != 1, "--zero1": args.zero1,
-            "--mu-bf16": args.mu_bf16,
+            "--mu-bf16": args.mu_bf16, "--accum-bf16": args.accum_bf16,
             "--no-text-remat": args.no_text_remat,
             "--steps-per-call": args.steps_per_call != 1,
             "--use-pallas": args.use_pallas,
@@ -851,6 +859,9 @@ def main():
     if args.steps_per_call < 1 or args.steps % args.steps_per_call:
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")
+    if args.accum_bf16 and args.accum == 1:
+        ap.error("--accum-bf16 requires --accum > 1 "
+                 "(the unaccumulated step has no accumulator)")
     if args.step_breakdown:
         # Flags the breakdown mode cannot honor are refused up front (BEFORE
         # the possibly-minutes-long backend probe); a silently different
@@ -859,6 +870,7 @@ def main():
         # threaded through instead.
         unsupported = {
             "--accum": args.accum != 1, "--zero1": args.zero1,
+            "--accum-bf16": args.accum_bf16,
             "--moe": bool(args.moe), "--no-text-remat": args.no_text_remat,
             "--steps-per-call": args.steps_per_call != 1,
             "--accum-negatives": args.accum_negatives != "local",
@@ -980,6 +992,7 @@ def main():
         model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1,
         moe_aux_weight=0.01 if args.moe else None,
         accum_negatives=args.accum_negatives,
+        accum_dtype="bfloat16" if args.accum_bf16 else None,
     )
     batch = jax.device_put(batch, shardings)
 
@@ -1074,7 +1087,8 @@ def main():
     flops_b16 = model_forward_flops_per_pair(SigLIPConfig.b16())
     a100_ref = A100_REF_PAIRS_PER_SEC * flops_b16 / model_forward_flops_per_pair(cfg)
     record = {
-        "metric": f"siglip_vit{args.model}_train_pairs_per_sec_per_chip",
+        "metric": f"siglip_vit{args.model}_train_pairs_per_sec_per_chip"
+                  f"{args.metric_suffix}",
         "value": round(pairs_per_sec_per_chip, 2),
         "unit": "pairs/s/chip",
         "vs_baseline": round(pairs_per_sec_per_chip / a100_ref, 3),
@@ -1115,6 +1129,8 @@ def main():
         record["zero1"] = True
     if args.mu_bf16:
         record["adam_mu_dtype"] = "bfloat16"
+    if args.accum_bf16:
+        record["accum_dtype"] = "bfloat16"
     if args.no_text_remat:
         record["no_text_remat"] = True
     if hw_flops_per_step_per_dev is not None:
@@ -1131,5 +1147,40 @@ def main():
     return 0
 
 
+def _emit_32k_equiv_record() -> None:
+    """The no-args driver invocation prints TWO JSON lines: first the
+    32k-equivalent north-star record (BASELINE.json's stated metric is
+    pairs/sec/chip at GLOBAL batch 32k — on a v5e-8 that is 4096/chip,
+    run here as 16 microbatches of 256 with the bf16 accumulator), then the
+    single-chip sweet-spot headline LAST (drivers that parse one line take
+    the last). A subprocess keeps the two jitted programs' device state
+    fully separate; the child prints its own record — including the
+    degraded-mode line if the backend is down. A child that dies PAST the
+    probe (OOM, crash) prints no JSON — emit an error record for it here so
+    the _32k_equiv stream stays machine-readable instead of silently losing
+    its datapoint."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "4096", "5", "b16", "--accum", "16", "--accum-bf16",
+         "--metric-suffix", "_32k_equiv"],
+        check=False, capture_output=True, text=True,
+    )
+    sys.stderr.write(proc.stderr)
+    json_lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    for line in json_lines:
+        print(line)
+    if proc.returncode != 0 and not json_lines:
+        print(json.dumps({
+            "metric": "siglip_vitb16_train_pairs_per_sec_per_chip_32k_equiv",
+            "value": 0.0,
+            "unit": "pairs/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"32k-equiv child run exited {proc.returncode} "
+                     "with no JSON record (see stderr)",
+        }))
+
+
 if __name__ == "__main__":
+    if len(sys.argv) == 1 and "cpu" not in os.environ.get("JAX_PLATFORMS", ""):
+        _emit_32k_equiv_record()
     sys.exit(main())

@@ -288,6 +288,11 @@ def cmd_train(args) -> int:
         print(f"--pp-microbatches must be >= 1, got {args.pp_microbatches}",
               file=sys.stderr)
         return 2
+    if args.accum_bf16 and args.accum == 1:
+        # Same check exists in make_train_step; exit-2 here beats a deep raise.
+        print("--accum-bf16 requires --accum > 1 (the unaccumulated step has "
+              "no accumulator)", file=sys.stderr)
+        return 2
     if args.pp > 1 and args.accum > 1 and args.accum_negatives == "global":
         # Same check exists in make_train_step; repeat it HERE so the exit-2
         # message lands before the minutes-long create_train_state.
@@ -528,6 +533,7 @@ def cmd_train(args) -> int:
                        family=args.loss_family, precision="default"),
             accum_steps=args.accum,
             accum_negatives=args.accum_negatives,
+            accum_dtype="bfloat16" if args.accum_bf16 else None,
             zero1=args.zero1,
             ema_decay=args.ema_decay,
             moe_aux_weight=(
@@ -1079,6 +1085,10 @@ def main(argv=None) -> int:
     tr.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"], default="b16")
     tr.add_argument("--tiny", action="store_true", help="alias for --model tiny")
     tr.add_argument("--accum", type=int, default=1, help="grad-accumulation microsteps")
+    tr.add_argument("--accum-bf16", action="store_true",
+                    help="bf16 gradient accumulator under --accum (adds stay "
+                         "f32; halves the accumulator's HBM footprint and "
+                         "per-microstep read+write traffic)")
     tr.add_argument("--accum-negatives", choices=["local", "global"],
                     default="local",
                     help="with --accum > 1: 'local' contrasts each microbatch "

@@ -319,6 +319,7 @@ def make_train_step(
     moe_aux_weight: float | None = None,
     pp_microbatches: int = 0,
     accum_negatives: str = "local",
+    accum_dtype: str | None = None,
 ):
     """Build the jitted ``(state, batch) -> (state, metrics)`` step.
 
@@ -355,6 +356,17 @@ def make_train_step(
     times the mean of the routers' sown load-balancing losses (models/moe.py) to
     the task loss; without it MoE still trains but routing may collapse onto few
     experts.
+
+    ``accum_dtype`` (e.g. ``"bfloat16"``, with ``accum_steps > 1``) stores the
+    microbatch-scan gradient accumulator in that dtype instead of the param
+    dtype (f32). The adds still run in f32 (the accumulator is upcast, summed
+    with the microstep grad, and rounded back), so the only loss is the
+    per-microstep bf16 round-off — a ~``sqrt(M) * 2^-9`` relative random walk
+    on the sum, far below gradient noise at M=16. What it buys: the
+    params-sized accumulator's read+write per microstep halves (the HBM
+    traffic diagnosed as the accumulation tax in docs/PERF.md), and its
+    resident footprint halves — the lever that lets larger microbatches fit.
+    Parity oracles keep the f32 default (tests/test_train_step.py).
 
     ``pp_microbatches > 0`` runs both towers' block stacks through the GPipe
     schedule over the mesh's ``pp`` axis with that many microbatches per step
@@ -407,6 +419,31 @@ def make_train_step(
     # accum_steps == 1 with "global" is not an error — an unaccumulated step
     # already contrasts globally — it just takes the plain path.
     cached_accum = accum_negatives == "global" and accum_steps > 1
+    if accum_dtype is not None and accum_steps == 1:
+        # Refuse, don't drop: an unaccumulated step has no accumulator, and a
+        # config claiming accum_dtype that never ran poisons comparisons.
+        raise ValueError(
+            f"accum_dtype={accum_dtype!r} requires accum_steps > 1 "
+            f"(got {accum_steps}); the unaccumulated step has no accumulator"
+        )
+    acc_dt = jnp.dtype(accum_dtype) if accum_dtype is not None else None
+
+    def _accum_zeros(params):
+        return jax.tree.map(
+            lambda p: jnp.zeros(p.shape, acc_dt or p.dtype), params
+        )
+
+    def _accum_add(acc, g):
+        # Upcast-add-round: the sum itself stays f32 per microstep.
+        return jax.tree.map(
+            lambda a, g_: (a.astype(g_.dtype) + g_).astype(a.dtype), acc, g
+        )
+
+    def _accum_finish(acc, params, scale=None):
+        return jax.tree.map(
+            lambda a, p: (a.astype(p.dtype) / scale if scale else a.astype(p.dtype)),
+            acc, params,
+        )
     if cached_accum and pp_microbatches:
         raise ValueError(
             "accum_negatives='global' with pp_microbatches is not supported "
@@ -548,10 +585,10 @@ def make_train_step(
             (_, aux_), g = jax.value_and_grad(surrogate, has_aux=True)(
                 params, mb, g_zi, g_zt
             )
-            return jax.tree.map(jnp.add, grad_sum, g), aux_
+            return _accum_add(grad_sum, g), aux_
 
-        zeros = jax.tree.map(jnp.zeros_like, params)
-        grads, auxs = lax.scan(body, zeros, (micro, g_zis, g_zts))
+        grads, auxs = lax.scan(body, _accum_zeros(params), (micro, g_zis, g_zts))
+        grads = _accum_finish(grads, params)
         mean_aux = jnp.mean(auxs)
         if moe_aux_weight is not None:
             # The optimized objective includes the aux term; report the same
@@ -586,15 +623,14 @@ def make_train_step(
             (loss, (lp, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, mb
             )
-            carry = (loss_sum + loss, jax.tree.map(jnp.add, grad_sum, grads))
+            carry = (loss_sum + loss, _accum_add(grad_sum, grads))
             return carry, (lp, aux)
 
-        zeros = jax.tree.map(jnp.zeros_like, params)
         (loss_sum, grad_sum), (lps, auxs) = lax.scan(
-            body, (jnp.zeros(()), zeros), micro
+            body, (jnp.zeros(()), _accum_zeros(params)), micro
         )
         lp = jax.tree.map(lambda x: x[-1], lps)
-        grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
+        grads = _accum_finish(grad_sum, params, scale=accum_steps)
         return loss_sum / accum_steps, lp, jnp.mean(auxs), grads
 
     def step(state: TrainState, batch: dict):

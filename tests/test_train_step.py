@@ -162,6 +162,48 @@ def test_grad_accumulation_matches_mean_of_microbatch_grads():
         np.testing.assert_allclose(np.asarray(g), np.asarray(e), rtol=2e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("negatives", ["local", "global"])
+def test_bf16_accumulator_tracks_f32(negatives):
+    """accum_dtype='bfloat16' must reproduce the f32 accumulator's update to
+    bf16 round-off (the adds stay f32; only the carried sum is rounded) — and
+    the loss, which never touches the accumulator, must match exactly."""
+    import optax
+
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    mesh = make_mesh(4)
+    tx = optax.sgd(1.0)  # params expose the grads directly
+    batch = tiny_batch(16, cfg)
+    state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+
+    lc = LossConfig(variant="ring")
+    kw = dict(accum_steps=4, accum_negatives=negatives)
+    step_f32, shardings = make_train_step(model, mesh, lc, **kw)
+    step_bf16, _ = make_train_step(model, mesh, lc, accum_dtype="bfloat16", **kw)
+    batch = jax.device_put(batch, shardings)
+
+    copy = lambda s_: jax.tree.map(jnp.copy, s_)
+    s32, m32 = step_f32(copy(state), batch)
+    s16, m16 = step_bf16(copy(state), batch)
+
+    np.testing.assert_allclose(float(m16["loss"]), float(m32["loss"]), rtol=1e-6)
+    for a, b, p0 in zip(
+        jax.tree.leaves(s16.params),
+        jax.tree.leaves(s32.params),
+        jax.tree.leaves(state.params),
+    ):
+        # Compare the UPDATES (grads), not the params: sgd(1.0) makes
+        # update = p0 - p_new. bf16 keeps ~3 significant decimal digits of
+        # the CARRIED SUM, so elements that end small through cancellation
+        # need an absolute floor at the round-off scale (~max|g| * 2^-8).
+        g32 = np.asarray(p0 - b)
+        atol = max(2e-5, float(np.max(np.abs(g32))) * 2 ** -8)
+        np.testing.assert_allclose(np.asarray(p0 - a), g32, rtol=2e-2, atol=atol)
+    # Both steps' grads must also be float32 downstream of the accumulator
+    # (optax sees the param dtype, never bf16).
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(s16.params))
+
+
 def test_grad_accumulation_rejects_indivisible_batch():
     import jax
     import jax.numpy as jnp
