@@ -2,10 +2,12 @@
 """Headline benchmark: SigLIP ViT-B/16 train-step throughput (image-text pairs/sec/chip).
 
 Runs the full flagship train step — ViT-B/16 + text transformer + ring sigmoid loss +
-adamw update — on the real TPU chip at the measured single-chip sweet spot (288
-pairs/chip, save_hot remat, unrolled layers; the 32768-global north star maps to a
-v5e-128 or grad-accumulation steps on smaller slices, see --accum) and prints ONE JSON
-line with throughput, achieved TFLOP/s, and MFU.
+adamw update — on the real TPU chip at the measured single-chip sweet spot (round 4:
+2048 pairs per optimizer step as 16 accumulated microbatches of 128, save_hot remat,
+unrolled layers, bf16 accumulator + adam first moment) and prints ONE JSON line with
+throughput, achieved TFLOP/s, and MFU. The no-args driver invocation first emits an
+additional `..._32k_equiv` record: the same recipe at the 32k-global north-star
+per-chip shape (4096/chip = 32 microbatches of 128, the v5e-8 portion of global 32768).
 
 The reference publishes no benchmark numbers (BASELINE.md); the ``vs_baseline`` ratio is
 measured throughput vs the A100 ballpark for open_clip-style ViT-B/16 contrastive
@@ -766,6 +768,12 @@ def main():
     ap.add_argument("--metric-suffix", default="",
                     help="appended to the JSON metric name (the no-args driver "
                          "run tags its 32k-equivalent record _32k_equiv)")
+    ap.add_argument("--remat-policy", default="",
+                    choices=["", "nothing", "save_hot", "save_all_hot",
+                             "save_mlp"],
+                    help="override both towers' remat policy (default: the "
+                         "per-model measured best — save_hot for b16, full "
+                         "remat for l14/so400m)")
     ap.add_argument("--moe", type=int, default=0, metavar="E",
                     help="mixture-of-experts towers with E experts per block "
                          "(replicated on 1 chip; shard over ep on a pod)")
@@ -844,6 +852,8 @@ def main():
         unsupported = {
             "--accum": args.accum != 1, "--zero1": args.zero1,
             "--mu-bf16": args.mu_bf16, "--accum-bf16": args.accum_bf16,
+            "--remat-policy": bool(args.remat_policy),
+            "--metric-suffix": bool(args.metric_suffix),
             "--no-text-remat": args.no_text_remat,
             "--steps-per-call": args.steps_per_call != 1,
             "--use-pallas": args.use_pallas,
@@ -871,6 +881,8 @@ def main():
         unsupported = {
             "--accum": args.accum != 1, "--zero1": args.zero1,
             "--accum-bf16": args.accum_bf16,
+            "--remat-policy": bool(args.remat_policy),
+            "--metric-suffix": bool(args.metric_suffix),
             "--moe": bool(args.moe), "--no-text-remat": args.no_text_remat,
             "--steps-per-call": args.steps_per_call != 1,
             "--accum-negatives": args.accum_negatives != "local",
@@ -952,6 +964,12 @@ def main():
             cfg,
             vision=dataclasses.replace(cfg.vision, scan_layers=False),
             text=dataclasses.replace(cfg.text, scan_layers=False),
+        )
+    if args.remat_policy:
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(cfg.vision, remat_policy=args.remat_policy),
+            text=dataclasses.replace(cfg.text, remat_policy=args.remat_policy),
         )
     model = SigLIP(cfg)
     tx = make_optimizer(
@@ -1151,36 +1169,57 @@ def _emit_32k_equiv_record() -> None:
     """The no-args driver invocation prints TWO JSON lines: first the
     32k-equivalent north-star record (BASELINE.json's stated metric is
     pairs/sec/chip at GLOBAL batch 32k — on a v5e-8 that is 4096/chip,
-    run here as 16 microbatches of 256 with the bf16 accumulator), then the
-    single-chip sweet-spot headline LAST (drivers that parse one line take
-    the last). A subprocess keeps the two jitted programs' device state
+    run here as 32 microbatches of 128 with save_hot remat and the bf16
+    accumulator + adam moment), then the single-chip sweet-spot headline
+    LAST (drivers that parse one line take the last). A subprocess keeps the two jitted programs' device state
     fully separate; the child prints its own record — including the
     degraded-mode line if the backend is down. A child that dies PAST the
     probe (OOM, crash) prints no JSON — emit an error record for it here so
     the _32k_equiv stream stays machine-readable instead of silently losing
     its datapoint."""
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__),
-         "4096", "5", "b16", "--accum", "16", "--accum-bf16",
-         "--metric-suffix", "_32k_equiv"],
-        check=False, capture_output=True, text=True,
-    )
-    sys.stderr.write(proc.stderr)
-    json_lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
-    for line in json_lines:
-        print(line)
-    if proc.returncode != 0 and not json_lines:
+    def error_record(why: str) -> None:
         print(json.dumps({
             "metric": "siglip_vitb16_train_pairs_per_sec_per_chip_32k_equiv",
             "value": 0.0,
             "unit": "pairs/s/chip",
             "vs_baseline": 0.0,
-            "error": f"32k-equiv child run exited {proc.returncode} "
-                     "with no JSON record (see stderr)",
+            "error": why,
         }))
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "4096", "5", "b16", "--accum", "32", "--accum-bf16", "--mu-bf16",
+             "--remat-policy", "save_hot",
+             "--metric-suffix", "_32k_equiv"],
+            check=False, capture_output=True, text=True,
+            timeout=float(os.environ.get("DSL_BENCH_32K_TIMEOUT", 1800)),
+        )
+    except subprocess.TimeoutExpired as e:
+        # A hung child (wedged tunnel, regressed shape) must not stall the
+        # headline run — surface it and move on.
+        sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")
+                         if isinstance(e.stderr, bytes) else (e.stderr or ""))
+        error_record(f"32k-equiv child run timed out after {e.timeout:.0f}s")
+        return
+    sys.stderr.write(proc.stderr)
+    json_lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    for line in json_lines:
+        print(line)
+    if proc.returncode != 0 and not json_lines:
+        error_record(f"32k-equiv child run exited {proc.returncode} "
+                     "with no JSON record (see stderr)")
 
 
 if __name__ == "__main__":
     if len(sys.argv) == 1 and "cpu" not in os.environ.get("JAX_PLATFORMS", ""):
         _emit_32k_equiv_record()
+        # The no-args HEADLINE is the measured single-chip sweet spot. Round 4
+        # moved it: 16 accumulated microbatches of 128 with save_hot remat
+        # (819 pairs/s, MFU 0.58) beat every no-accum shape (288/chip: 769.8)
+        # — the optimizer update amortizes over microsteps and mb-128 is the
+        # most compute-efficient microstep shape. Explicit invocations keep
+        # plain argparse defaults (batch 288, no accum).
+        sys.argv += ["2048", "5", "b16", "--accum", "16", "--accum-bf16",
+                     "--mu-bf16", "--remat-policy", "save_hot"]
     sys.exit(main())
