@@ -1197,10 +1197,17 @@ def _emit_32k_equiv_record() -> None:
         )
     except subprocess.TimeoutExpired as e:
         # A hung child (wedged tunnel, regressed shape) must not stall the
-        # headline run — surface it and move on.
-        sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")
-                         if isinstance(e.stderr, bytes) else (e.stderr or ""))
-        error_record(f"32k-equiv child run timed out after {e.timeout:.0f}s")
+        # headline run — but keep any record it printed BEFORE wedging
+        # (e.g. measured fine, hung in device teardown) over a value-0 stub.
+        def _text(s):
+            return s.decode("utf-8", "replace") if isinstance(s, bytes) else (s or "")
+
+        sys.stderr.write(_text(e.stderr))
+        salvaged = [l for l in _text(e.stdout).splitlines() if l.startswith("{")]
+        for line in salvaged:
+            print(line)
+        if not salvaged:
+            error_record(f"32k-equiv child run timed out after {e.timeout:.0f}s")
         return
     sys.stderr.write(proc.stderr)
     json_lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]

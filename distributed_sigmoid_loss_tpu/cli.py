@@ -138,6 +138,20 @@ def _make_training_mesh(args):
                 )
             arr = _hybrid_device_array(dcn, n_dev // dcn, 1, devices)
         else:
+            if devices and devices[0].platform == "tpu":
+                # On real single-slice TPU hardware the 'dcn' axis lands on
+                # ICI neighbors: the int8/top-k hop pays quantization loss on
+                # a fast link with zero bandwidth win. Warn loudly — the
+                # silent plain-reshape path exists for CPU emulation, where
+                # virtual devices carry no slice metadata.
+                print(
+                    f"WARNING: --dcn-slices {dcn} on single-slice TPU "
+                    "hardware — the 'dcn' axis maps onto ICI neighbors, so "
+                    "compressed gradient sync pays quantization loss on a "
+                    "fast link with no bandwidth win (intended for "
+                    "multi-slice DCN topologies or CPU emulation)",
+                    file=sys.stderr,
+                )
             arr = np.array(devices)
         return (
             Mesh(arr.reshape(dcn, n_dev // dcn), ("dcn", data_axis)),
@@ -202,6 +216,37 @@ def _byte_tokenize_for(cfg, vocab_path: str = ""):
     return tokenize
 
 
+def _eval_holdout_source(args, cfg, tokenize, native_decode: bool):
+    """Build the --eval-data holdout source (directory or tar-shard glob).
+
+    Yields GLOBAL batches of ``args.batch`` rows on every host (place_global
+    slices process-wise) — the eval batch is one fixed batch, so the striped
+    multi-host read path is deliberately not used here. ``native_decode``
+    must match the training stream's decoder: PIL and the native libjpeg
+    engine produce numerically different pixels, and a decode-skewed eval
+    batch would measure the wrong distribution.
+    """
+    import os
+
+    from distributed_sigmoid_loss_tpu.data import ImageTextFolder, ImageTextShards
+
+    if os.path.isdir(args.eval_data):
+        return ImageTextFolder(
+            args.eval_data, cfg, args.batch, tokenize,
+            native_decode=native_decode,
+        )
+    import glob as globmod
+
+    shards = globmod.glob(args.eval_data)
+    if not shards:
+        # Same exit-2 usage-error channel as '--data-shards matched nothing'.
+        print(f"--eval-data matched nothing: {args.eval_data!r}", file=sys.stderr)
+        raise SystemExit(2)
+    return ImageTextShards(
+        shards, cfg, args.batch, tokenize, native_decode=native_decode,
+    )
+
+
 def cmd_train(args) -> int:
     _bootstrap_devices(args)
     import jax
@@ -209,6 +254,10 @@ def cmd_train(args) -> int:
     if args.async_checkpoint and not args.ckpt_dir:
         print("--async-checkpoint without --ckpt-dir would be a silent no-op "
               "(there is nothing to save)", file=sys.stderr)
+        return 2
+    if args.eval_data and not args.eval_every:
+        print("--eval-data without --eval-every would be a silent no-op "
+              "(nothing ever evaluates it)", file=sys.stderr)
         return 2
     if args.coordinator:
         if args.num_processes < 1 or args.process_id < 0:
@@ -421,6 +470,7 @@ def cmd_train(args) -> int:
         print("--native-decode without --data-dir/--data-shards would be a "
               "silent no-op (synthetic data is not decoded)", file=sys.stderr)
         return 2
+    native_decode = False  # resolved by the file-stream branch; read by --eval-data
     if args.data_dir or args.data_shards:
         from distributed_sigmoid_loss_tpu.data import (
             ImageTextFolder,
@@ -428,7 +478,6 @@ def cmd_train(args) -> int:
         )
 
         tokenize = _byte_tokenize_for(cfg, args.tokenizer)
-        native_decode = False
         if args.native_decode:
             from distributed_sigmoid_loss_tpu.data.native_decode import (
                 native_decode_available,
@@ -558,14 +607,12 @@ def cmd_train(args) -> int:
 
     batch_axes = ("dcn", _da) if args.dcn_slices > 1 else _da
 
-    def place(b):
+    def place_global(b):
+        # Reference-style full-batch-then-slice (test_distributed_sigmoid_loss.py:
+        # 57-68): every host holds the same global batch and contributes the
+        # process-order slice its own devices hold.
         if pcnt == 1:
             return jax.device_put(b, shardings)
-        if rows_are_local:
-            return global_batch_from_local(b, mesh, axis_name=batch_axes)
-        # Reference-style full-batch-then-slice (test_distributed_sigmoid_loss.py:
-        # 57-68): every host generates the same deterministic global batch and
-        # contributes the process-order slice its own devices hold.
         import numpy as np
 
         local = jax.tree.map(
@@ -575,6 +622,11 @@ def cmd_train(args) -> int:
             b,
         )
         return global_batch_from_local(local, mesh, axis_name=batch_axes)
+
+    def place(b):
+        if pcnt > 1 and rows_are_local:
+            return global_batch_from_local(b, mesh, axis_name=batch_axes)
+        return place_global(b)
 
     def device_batches(skip: int = 0):
         # The synthetic pipeline is deterministic per position: on resume, skip
@@ -596,15 +648,30 @@ def cmd_train(args) -> int:
         # position, so a resume with a different --eval-every would silently
         # train on a different stream than the original run (breaking
         # device_batches' skip arithmetic). Synthetic runs get a genuinely
-        # held-out source (shifted seeds); file/native streams reuse the
-        # already-drawn position-0 batch.
-        if isinstance(source, SyntheticImageText):
+        # held-out source (shifted seeds); file/native streams use the
+        # --eval-data holdout when given and otherwise fall back to the
+        # already-drawn position-0 batch (disclosed: that curve partially
+        # measures train-set fit).
+        if args.eval_data:
+            eval_batch = place_global(next(iter(_eval_holdout_source(
+                args, cfg, _byte_tokenize_for(cfg, args.tokenizer),
+                native_decode=native_decode,
+            ))))
+        elif isinstance(source, SyntheticImageText):
             eval_batch = place(
                 next(iter(SyntheticImageText(
                     cfg, args.batch, image_seed=43, text_seed=41
                 )))
             )
         else:
+            print(
+                "--eval-every without --eval-data on a file/native stream: "
+                "the fixed eval batch is the position-0 TRAINING batch, so "
+                "the curve partially measures train-set fit — pass "
+                "--eval-data with held-out shards or a directory for a true "
+                "validation curve",
+                file=sys.stderr,
+            )
             eval_batch = place(first)
         # Jitted once: the hook runs repeatedly inside the train loop, where
         # an eager per-op forward would dominate wall time on real models.
@@ -1174,8 +1241,14 @@ def main(argv=None) -> int:
                          "(eval/i2t_recall@K ...) on one fixed batch — the "
                          "in-training validation curve. Synthetic runs use a "
                          "genuinely held-out batch (shifted seeds); file/"
-                         "native streams reuse the first training batch, so "
-                         "the curve there includes train-set fit")
+                         "native streams use --eval-data when given, else "
+                         "fall back (with a warning) to the first training "
+                         "batch, so the curve there includes train-set fit")
+    tr.add_argument("--eval-data", default="", metavar="PATH_OR_GLOB",
+                    help="held-out eval source for --eval-every: a directory "
+                         "(ImageTextFolder layout) or a tar-shard glob kept "
+                         "OUT of --data-dir/--data-shards — makes the "
+                         "in-training curve a true validation curve")
     tr.add_argument("--log-every", type=int, default=1)
     tr.add_argument("--coordinator", default="",
                     help="multi-process rendezvous address host:port — every "

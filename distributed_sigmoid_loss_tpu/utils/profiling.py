@@ -47,13 +47,24 @@ def _drain(out) -> None:
     runtime (docs/PERF.md round-3 notes), so also transfer ONE element of the
     first array leaf — a host transfer cannot complete before the producing
     computation does, and a 1-element slice costs nothing on device.
+
+    Multihost: a leaf sharded across processes is not fully addressable, and
+    ``np.asarray`` on it raises RuntimeError — read one element from this
+    process's first addressable shard instead (same synchronization property:
+    the shard's producing computation must finish before the transfer).
     """
     jax.block_until_ready(out)
     leaves = [x for x in jax.tree.leaves(out) if hasattr(x, "dtype")]
     if leaves:
         import numpy as np
 
-        np.asarray(jax.numpy.ravel(leaves[0])[:1])
+        leaf = leaves[0]
+        if getattr(leaf, "is_fully_addressable", True):
+            np.asarray(jax.numpy.ravel(leaf)[:1])
+        else:
+            shards = leaf.addressable_shards
+            if shards:
+                np.asarray(jax.numpy.ravel(shards[0].data)[:1])
 
 
 def time_step(fn: Callable, *args, warmup: int = 3, iters: int = 10) -> float:
