@@ -1203,7 +1203,15 @@ def _emit_32k_equiv_record() -> None:
             return s.decode("utf-8", "replace") if isinstance(s, bytes) else (s or "")
 
         sys.stderr.write(_text(e.stderr))
-        salvaged = [l for l in _text(e.stdout).splitlines() if l.startswith("{")]
+        salvaged = []
+        for line in _text(e.stdout).splitlines():
+            # A kill mid-write leaves a truncated line — only valid JSON may
+            # enter the metric stream.
+            try:
+                json.loads(line)
+            except ValueError:
+                continue
+            salvaged.append(line)
         for line in salvaged:
             print(line)
         if not salvaged:

@@ -259,6 +259,16 @@ def cmd_train(args) -> int:
         print("--eval-data without --eval-every would be a silent no-op "
               "(nothing ever evaluates it)", file=sys.stderr)
         return 2
+    if args.eval_data:
+        # Validate the path NOW — the eval hook is built after the
+        # minutes-long state init, far too late for a typo'd glob.
+        import glob as _globmod
+        import os as _os
+
+        if not _os.path.isdir(args.eval_data) and not _globmod.glob(args.eval_data):
+            print(f"--eval-data matched nothing: {args.eval_data!r}",
+                  file=sys.stderr)
+            return 2
     if args.coordinator:
         if args.num_processes < 1 or args.process_id < 0:
             print(
@@ -470,7 +480,10 @@ def cmd_train(args) -> int:
         print("--native-decode without --data-dir/--data-shards would be a "
               "silent no-op (synthetic data is not decoded)", file=sys.stderr)
         return 2
-    native_decode = False  # resolved by the file-stream branch; read by --eval-data
+    # Resolved by the file-stream branch; read by the --eval-data holdout so
+    # eval decode/tokenization matches training exactly.
+    native_decode = False
+    tokenize = None
     if args.data_dir or args.data_shards:
         from distributed_sigmoid_loss_tpu.data import (
             ImageTextFolder,
@@ -653,10 +666,17 @@ def cmd_train(args) -> int:
         # already-drawn position-0 batch (disclosed: that curve partially
         # measures train-set fit).
         if args.eval_data:
-            eval_batch = place_global(next(iter(_eval_holdout_source(
-                args, cfg, _byte_tokenize_for(cfg, args.tokenizer),
-                native_decode=native_decode,
-            ))))
+            try:
+                eval_batch = place_global(next(iter(_eval_holdout_source(
+                    args, cfg,
+                    tokenize or _byte_tokenize_for(cfg, args.tokenizer),
+                    native_decode=native_decode,
+                ))))
+            except ValueError as e:
+                # e.g. a holdout folder with fewer pairs than --batch: usage
+                # error, not a traceback.
+                print(f"--eval-data: {e}", file=sys.stderr)
+                return 2
         elif isinstance(source, SyntheticImageText):
             eval_batch = place(
                 next(iter(SyntheticImageText(

@@ -18,6 +18,8 @@ from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import (
     sigmoid_loss_block,
 )
 
+pytestmark = pytest.mark.smoke  # fast core-oracle tier (pyproject markers)
+
 
 def numpy_sigmoid_loss(zimg, ztxt, t_prime, bias, negative_only=False):
     """Independent oracle: SigLIP Algorithm 1 in NumPy (float64)."""

@@ -23,6 +23,8 @@ from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import (
 )
 from distributed_sigmoid_loss_tpu.parallel import make_mesh, make_sharded_loss_fn
 
+pytestmark = pytest.mark.smoke  # fast core-oracle tier (pyproject markers)
+
 RTOL = 1e-4  # build target (BASELINE.md): tighter than the reference's 1e-3
 
 

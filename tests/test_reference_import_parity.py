@@ -24,10 +24,13 @@ torch = pytest.importorskip("torch")
 
 REFERENCE_DIR = "/root/reference"
 
-pytestmark = pytest.mark.skipif(
-    not os.path.exists(os.path.join(REFERENCE_DIR, "distributed_sigmoid_loss.py")),
-    reason="reference checkout not available",
-)
+pytestmark = [
+    pytest.mark.skipif(
+        not os.path.exists(os.path.join(REFERENCE_DIR, "distributed_sigmoid_loss.py")),
+        reason="reference checkout not available",
+    ),
+    pytest.mark.smoke,  # fast core-oracle tier (pyproject markers)
+]
 
 RTOL = 1e-4
 

@@ -23,6 +23,8 @@ from distributed_sigmoid_loss_tpu.utils.parity_data import (  # noqa: E402
     reference_encoder_weights,
 )
 
+pytestmark = pytest.mark.smoke  # fast core-oracle tier (pyproject markers)
+
 RTOL = 1e-4
 
 
