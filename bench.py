@@ -1165,6 +1165,22 @@ def main():
     return 0
 
 
+def _emit_valid_json_lines(text: str) -> int:
+    """Print every stdout line that parses as JSON; return how many did.
+
+    A child killed mid-write (SIGKILL, OOM, timeout) leaves a truncated final
+    line — only valid JSON may enter the metric stream."""
+    n = 0
+    for line in text.splitlines():
+        try:
+            json.loads(line)
+        except ValueError:
+            continue
+        print(line)
+        n += 1
+    return n
+
+
 def _emit_32k_equiv_record() -> None:
     """The no-args driver invocation prints TWO JSON lines: first the
     32k-equivalent north-star record (BASELINE.json's stated metric is
@@ -1203,25 +1219,12 @@ def _emit_32k_equiv_record() -> None:
             return s.decode("utf-8", "replace") if isinstance(s, bytes) else (s or "")
 
         sys.stderr.write(_text(e.stderr))
-        salvaged = []
-        for line in _text(e.stdout).splitlines():
-            # A kill mid-write leaves a truncated line — only valid JSON may
-            # enter the metric stream.
-            try:
-                json.loads(line)
-            except ValueError:
-                continue
-            salvaged.append(line)
-        for line in salvaged:
-            print(line)
+        salvaged = _emit_valid_json_lines(_text(e.stdout))
         if not salvaged:
             error_record(f"32k-equiv child run timed out after {e.timeout:.0f}s")
         return
     sys.stderr.write(proc.stderr)
-    json_lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
-    for line in json_lines:
-        print(line)
-    if proc.returncode != 0 and not json_lines:
+    if not _emit_valid_json_lines(proc.stdout) and proc.returncode != 0:
         error_record(f"32k-equiv child run exited {proc.returncode} "
                      "with no JSON record (see stderr)")
 

@@ -216,6 +216,23 @@ def _byte_tokenize_for(cfg, vocab_path: str = ""):
     return tokenize
 
 
+def _resolve_eval_data(path: str):
+    """Resolve --eval-data to ("dir", path) / ("shards", [tars]) / (None, error).
+
+    ONE resolution helper shared by cmd_train's early usage check and the
+    source build, so the two can never disagree on what a valid path is.
+    """
+    import glob as globmod
+    import os
+
+    if os.path.isdir(path):
+        return "dir", path
+    shards = globmod.glob(path)
+    if shards:
+        return "shards", shards
+    return None, f"--eval-data matched nothing: {path!r}"
+
+
 def _eval_holdout_source(args, cfg, tokenize, native_decode: bool):
     """Build the --eval-data holdout source (directory or tar-shard glob).
 
@@ -226,25 +243,21 @@ def _eval_holdout_source(args, cfg, tokenize, native_decode: bool):
     engine produce numerically different pixels, and a decode-skewed eval
     batch would measure the wrong distribution.
     """
-    import os
-
     from distributed_sigmoid_loss_tpu.data import ImageTextFolder, ImageTextShards
 
-    if os.path.isdir(args.eval_data):
+    kind, resolved = _resolve_eval_data(args.eval_data)
+    if kind == "dir":
         return ImageTextFolder(
-            args.eval_data, cfg, args.batch, tokenize,
-            native_decode=native_decode,
+            resolved, cfg, args.batch, tokenize, native_decode=native_decode,
         )
-    import glob as globmod
-
-    shards = globmod.glob(args.eval_data)
-    if not shards:
-        # Same exit-2 usage-error channel as '--data-shards matched nothing'.
-        print(f"--eval-data matched nothing: {args.eval_data!r}", file=sys.stderr)
-        raise SystemExit(2)
-    return ImageTextShards(
-        shards, cfg, args.batch, tokenize, native_decode=native_decode,
-    )
+    if kind == "shards":
+        return ImageTextShards(
+            resolved, cfg, args.batch, tokenize, native_decode=native_decode,
+        )
+    # Same exit-2 usage-error channel as '--data-shards matched nothing'
+    # (cmd_train pre-validates; this is the non-train-caller backstop).
+    print(resolved, file=sys.stderr)
+    raise SystemExit(2)
 
 
 def cmd_train(args) -> int:
@@ -262,12 +275,9 @@ def cmd_train(args) -> int:
     if args.eval_data:
         # Validate the path NOW — the eval hook is built after the
         # minutes-long state init, far too late for a typo'd glob.
-        import glob as _globmod
-        import os as _os
-
-        if not _os.path.isdir(args.eval_data) and not _globmod.glob(args.eval_data):
-            print(f"--eval-data matched nothing: {args.eval_data!r}",
-                  file=sys.stderr)
+        kind, resolved = _resolve_eval_data(args.eval_data)
+        if kind is None:
+            print(resolved, file=sys.stderr)
             return 2
     if args.coordinator:
         if args.num_processes < 1 or args.process_id < 0:
@@ -666,17 +676,20 @@ def cmd_train(args) -> int:
         # already-drawn position-0 batch (disclosed: that curve partially
         # measures train-set fit).
         if args.eval_data:
+            holdout = _eval_holdout_source(
+                args, cfg, tokenize or _byte_tokenize_for(cfg, args.tokenizer),
+                native_decode=native_decode,
+            )
             try:
-                eval_batch = place_global(next(iter(_eval_holdout_source(
-                    args, cfg,
-                    tokenize or _byte_tokenize_for(cfg, args.tokenizer),
-                    native_decode=native_decode,
-                ))))
+                # Drawing the batch is where a too-small holdout surfaces
+                # (ValueError from the loader): usage error, not a traceback.
+                # place_global stays OUTSIDE the try — its sharding errors are
+                # batch/topology mistakes, not --eval-data's fault.
+                eval_first = next(iter(holdout))
             except ValueError as e:
-                # e.g. a holdout folder with fewer pairs than --batch: usage
-                # error, not a traceback.
                 print(f"--eval-data: {e}", file=sys.stderr)
                 return 2
+            eval_batch = place_global(eval_first)
         elif isinstance(source, SyntheticImageText):
             eval_batch = place(
                 next(iter(SyntheticImageText(
