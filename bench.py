@@ -1169,11 +1169,13 @@ def _emit_valid_json_lines(text: str) -> int:
     """Print every stdout line that parses as JSON; return how many did.
 
     A child killed mid-write (SIGKILL, OOM, timeout) leaves a truncated final
-    line — only valid JSON may enter the metric stream."""
+    line — only a valid JSON OBJECT may enter the metric stream (bare
+    numbers/null from stray library prints parse too, but are not records)."""
     n = 0
     for line in text.splitlines():
         try:
-            json.loads(line)
+            if not isinstance(json.loads(line), dict):
+                continue
         except ValueError:
             continue
         print(line)

@@ -676,15 +676,17 @@ def cmd_train(args) -> int:
         # already-drawn position-0 batch (disclosed: that curve partially
         # measures train-set fit).
         if args.eval_data:
-            holdout = _eval_holdout_source(
-                args, cfg, tokenize or _byte_tokenize_for(cfg, args.tokenizer),
-                native_decode=native_decode,
-            )
             try:
-                # Drawing the batch is where a too-small holdout surfaces
-                # (ValueError from the loader): usage error, not a traceback.
-                # place_global stays OUTSIDE the try — its sharding errors are
-                # batch/topology mistakes, not --eval-data's fault.
+                # A too-small holdout surfaces as a loader ValueError — at
+                # construction for the directory source, at first draw for
+                # shards: usage error, not a traceback. place_global stays
+                # OUTSIDE the try — its sharding errors are batch/topology
+                # mistakes, not --eval-data's fault.
+                holdout = _eval_holdout_source(
+                    args, cfg,
+                    tokenize or _byte_tokenize_for(cfg, args.tokenizer),
+                    native_decode=native_decode,
+                )
                 eval_first = next(iter(holdout))
             except ValueError as e:
                 print(f"--eval-data: {e}", file=sys.stderr)
