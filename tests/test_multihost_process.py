@@ -255,12 +255,28 @@ def test_two_process_kill9_resume_matches_uninterrupted(tmp_path):
             p.kill()
         pytest.fail(f"first checkpoint never appeared under {dir_i}")
     if any(p.poll() is not None for p in procs):
-        outs = [(p.poll(), "") for p in procs]
+        # A child exited before the kill could land: drain outputs for
+        # diagnostics, then classify — rendezvous failure (skip), run
+        # completed on a too-fast host (skip: the drill needs a live victim),
+        # or a genuine crash (fail with the output tail).
+        outs = []
         for p in procs:
-            p.kill()
-        if any(rc == 3 for rc, _ in outs if rc is not None):
-            pytest.skip("jax.distributed rendezvous unavailable")
-        pytest.fail(f"interrupted-run process exited early: {outs}")
+            try:
+                out, _ = p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append((p.returncode, out))
+        if any(rc == 3 for rc, _ in outs):
+            pytest.skip(
+                "jax.distributed rendezvous unavailable: " + outs[0][1][-500:]
+            )
+        if all(rc == 0 for rc, _ in outs):
+            pytest.skip("interrupted run finished before the kill could land")
+        pytest.fail(
+            "interrupted-run process exited early: "
+            f"{[(rc, o[-1500:]) for rc, o in outs]}"
+        )
     procs[1].kill()  # SIGKILL — the hard-failure drill, no SIGTERM grace
     # The survivor is now wedged in (or heading into) a cross-process
     # collective that will never complete — that IS the failure mode; tear it
@@ -276,7 +292,9 @@ def test_two_process_kill9_resume_matches_uninterrupted(tmp_path):
 
     latest_after_kill = latest_step(dir_i)
     assert latest_after_kill is not None
-    assert ckpt_every <= latest_after_kill < steps
+    if latest_after_kill >= steps:
+        pytest.skip("interrupted run reached the final step before the kill landed")
+    assert ckpt_every <= latest_after_kill
 
     # Restart both processes on the same --ckpt-dir: they must resume from
     # the newest complete checkpoint and finish the remaining steps.
