@@ -36,3 +36,37 @@ import jax  # noqa: E402
 if not _USE_REAL_TPU:
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+
+def write_tar_shard(path, items, fmt="PNG", quality=None):
+    """Webdataset-style (image, caption) tar shard — THE shared test writer.
+
+    ``items``: iterable of ``(name, image, caption)`` where ``image`` is a PIL
+    Image or an (h, w, 3) uint8 array. One member pair per item:
+    ``<name>.png|jpg`` + ``<name>.txt``. Import with ``from conftest import
+    write_tar_shard`` — the four suites that stream shards (files-data, cli,
+    multihost-process, convergence) share this single encoding of the loader's
+    member-layout contract.
+    """
+    import io
+    import tarfile
+
+    import numpy as np
+    from PIL import Image
+
+    ext = {"PNG": "png", "JPEG": "jpg"}[fmt]
+    save_kw = {"quality": quality} if (fmt == "JPEG" and quality) else {}
+    with tarfile.open(path, "w") as tf:
+        for name, img, cap in items:
+            if isinstance(img, np.ndarray):
+                img = Image.fromarray(img)
+            buf = io.BytesIO()
+            img.save(buf, fmt, **save_kw)
+            blob = buf.getvalue()
+            info = tarfile.TarInfo(f"{name}.{ext}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+            txt = cap.encode()
+            info = tarfile.TarInfo(f"{name}.txt")
+            info.size = len(txt)
+            tf.addfile(info, io.BytesIO(txt))

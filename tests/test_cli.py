@@ -352,24 +352,19 @@ def test_train_tiny_pp_smoke():
 
 def test_eval_real_data_shards(tmp_path):
     """eval --data-shards drives the tar-shard loader end to end."""
-    import io
-    import tarfile
-
     from PIL import Image
 
-    with tarfile.open(str(tmp_path / "s0.tar"), "w") as tf:
-        for i in range(8):
-            im = Image.new("RGB", (20, 16), ((i * 31) % 256, 90, 40))
-            buf = io.BytesIO()
-            im.save(buf, "JPEG")
-            png = buf.getvalue()
-            info = tarfile.TarInfo(f"s{i:04d}.jpg")
-            info.size = len(png)
-            tf.addfile(info, io.BytesIO(png))
-            txt = f"thing {i % 4}".encode()
-            info = tarfile.TarInfo(f"s{i:04d}.txt")
-            info.size = len(txt)
-            tf.addfile(info, io.BytesIO(txt))
+    from conftest import write_tar_shard
+
+    write_tar_shard(
+        str(tmp_path / "s0.tar"),
+        [
+            (f"s{i:04d}", Image.new("RGB", (20, 16), ((i * 31) % 256, 90, 40)),
+             f"thing {i % 4}")
+            for i in range(8)
+        ],
+        fmt="JPEG",
+    )
     proc = _run(
         ["eval", "--cpu-devices", "4", "--tiny", "--batch", "8",
          "--data-shards", str(tmp_path / "*.tar")]

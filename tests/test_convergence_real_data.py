@@ -14,12 +14,10 @@ Run as subprocesses (the CLI owns its platform bring-up, same pattern as
 tests/test_cli.py).
 """
 
-import io
 import json
 import os
 import subprocess
 import sys
-import tarfile
 
 import numpy as np
 import pytest
@@ -40,22 +38,9 @@ CHANCE = 1.0 / len(NAMES)  # 0.0625 for recall@1 on the 16-pair holdout
 
 
 def _write_tar(path, items, fmt):
-    from PIL import Image
+    from conftest import write_tar_shard
 
-    ext = {"PNG": "png", "JPEG": "jpg"}[fmt]
-    with tarfile.open(path, "w") as tf:
-        for name, arr, cap in items:
-            img = Image.fromarray(arr)
-            b = io.BytesIO()
-            img.save(b, fmt, **({"quality": 95} if fmt == "JPEG" else {}))
-            blob = b.getvalue()
-            info = tarfile.TarInfo(f"{name}.{ext}")
-            info.size = len(blob)
-            tf.addfile(info, io.BytesIO(blob))
-            t = cap.encode()
-            info = tarfile.TarInfo(f"{name}.txt")
-            info.size = len(t)
-            tf.addfile(info, io.BytesIO(t))
+    write_tar_shard(path, items, fmt=fmt, quality=95 if fmt == "JPEG" else None)
 
 
 def _make_dataset(tmp_path, fmt):

@@ -112,23 +112,22 @@ def test_folder_deterministic_given_seed(tmp_path):
 
 
 def _make_shards(tmp_path, n_shards, per_shard):
-    paths = []
-    idx = 0
+    from PIL import Image
+
+    from conftest import write_tar_shard
+
+    paths, idx = [], 0
     for s in range(n_shards):
         path = str(tmp_path / f"shard{s:02d}.tar")
-        with tarfile.open(path, "w") as tf:
-            import io
-
-            for _ in range(per_shard):
-                png = _png_bytes(18, 14, (idx * 7 % 256, 90, 10))
-                info = tarfile.TarInfo(f"s{idx:04d}.png")
-                info.size = len(png)
-                tf.addfile(info, io.BytesIO(png))
-                txt = f"caption {idx}".encode()
-                info = tarfile.TarInfo(f"s{idx:04d}.txt")
-                info.size = len(txt)
-                tf.addfile(info, io.BytesIO(txt))
-                idx += 1
+        items = []
+        for _ in range(per_shard):
+            items.append((
+                f"s{idx:04d}",
+                Image.new("RGB", (18, 14), (idx * 7 % 256, 90, 10)),
+                f"caption {idx}",
+            ))
+            idx += 1
+        write_tar_shard(path, items)
         paths.append(path)
     return paths
 

@@ -147,30 +147,23 @@ def test_initialize_refuses_silent_degrade_with_multihost_marker(monkeypatch):
 
 
 def _make_shards(tmp_path, n_shards, per_shard):
-    """Tiny tar shards of (png, txt) pairs — inline twin of the
-    test_files_data helper (tests are not an importable package)."""
-    import io
-    import tarfile
+    """Tiny tar shards of (png, txt) pairs via the shared conftest writer."""
+    from conftest import write_tar_shard
 
     from PIL import Image
 
     paths, idx = [], 0
     for s in range(n_shards):
         path = str(tmp_path / f"shard{s:02d}.tar")
-        with tarfile.open(path, "w") as tf:
-            for _ in range(per_shard):
-                im = Image.new("RGB", (18, 14), (idx * 7 % 256, 90, 10))
-                buf = io.BytesIO()
-                im.save(buf, "PNG")
-                png = buf.getvalue()
-                info = tarfile.TarInfo(f"s{idx:04d}.png")
-                info.size = len(png)
-                tf.addfile(info, io.BytesIO(png))
-                txt = f"caption {idx}".encode()
-                info = tarfile.TarInfo(f"s{idx:04d}.txt")
-                info.size = len(txt)
-                tf.addfile(info, io.BytesIO(txt))
-                idx += 1
+        items = []
+        for _ in range(per_shard):
+            items.append((
+                f"s{idx:04d}",
+                Image.new("RGB", (18, 14), (idx * 7 % 256, 90, 10)),
+                f"caption {idx}",
+            ))
+            idx += 1
+        write_tar_shard(path, items)
         paths.append(path)
     return paths
 
@@ -268,8 +261,9 @@ def test_two_process_kill9_resume_matches_uninterrupted(tmp_path):
                 out, _ = p.communicate()
             outs.append((p.returncode, out))
         if any(rc == 3 for rc, _ in outs):
+            rdv_out = next(o for rc, o in outs if rc == 3)
             pytest.skip(
-                "jax.distributed rendezvous unavailable: " + outs[0][1][-500:]
+                "jax.distributed rendezvous unavailable: " + rdv_out[-500:]
             )
         if all(rc == 0 for rc, _ in outs):
             pytest.skip("interrupted run finished before the kill could land")
