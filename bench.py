@@ -247,6 +247,8 @@ def run_eval_throughput(args) -> int:
         tower_kw["moe_num_selected"] = args.moe_k
         if args.moe_group_size:
             tower_kw["moe_group_size"] = args.moe_group_size
+        if args.moe_cf is not None:
+            tower_kw["moe_capacity_factor"] = args.moe_cf
     cfg = dataclasses.replace(
         cfg,
         vision=dataclasses.replace(cfg.vision, **tower_kw),
@@ -306,6 +308,8 @@ def run_eval_throughput(args) -> int:
         record["moe_num_selected"] = args.moe_k
         if args.moe_group_size:
             record["moe_group_size"] = args.moe_group_size
+        if args.moe_cf is not None:
+            record["moe_capacity_factor"] = args.moe_cf
     if peak is not None:
         record["mfu_bf16_basis"] = round(tflops / peak, 3)
     print(json.dumps(record))
@@ -783,6 +787,10 @@ def main():
                     help="GShard routing group size (with --moe; default 512): "
                          "capacity is per-group, so smaller groups shrink the "
                          "dispatch tensors for tight HBM budgets")
+    ap.add_argument("--moe-cf", type=float, default=None, metavar="F",
+                    help="MoE capacity factor (with --moe; default 1.25): "
+                         "per-expert buffer slack — smaller cuts the padded "
+                         "expert FLOPs, at higher token-drop rates")
     ap.add_argument("--attn-impl", default="auto",
                     choices=["auto", "dense", "flash"],
                     help="tower attention core: auto = fused Pallas kernel for "
@@ -830,6 +838,10 @@ def main():
         ap.error(f"--moe must be >= 2 experts (or 0 for dense), got {args.moe}")
     if args.moe_k != 1 and not args.moe:
         ap.error("--moe-k without --moe would be a silent no-op")
+    if args.moe_cf is not None and not args.moe:
+        ap.error("--moe-cf without --moe would be a silent no-op")
+    if args.moe_cf is not None and args.moe_cf <= 0:
+        ap.error(f"--moe-cf must be > 0, got {args.moe_cf}")
     if args.quant and not args.eval_throughput:
         ap.error("--quant without --eval-throughput would be a silent no-op "
                  "(the train bench never quantizes: training through round() "
@@ -933,6 +945,8 @@ def main():
         moe_kw = {"moe_experts": args.moe, "moe_num_selected": args.moe_k}
         if args.moe_group_size:
             moe_kw["moe_group_size"] = args.moe_group_size
+        if args.moe_cf is not None:
+            moe_kw["moe_capacity_factor"] = args.moe_cf
         cfg = dataclasses.replace(
             cfg,
             vision=dataclasses.replace(cfg.vision, **moe_kw),
@@ -1143,6 +1157,10 @@ def main():
     if args.moe:
         record["moe_experts"] = args.moe
         record["moe_num_selected"] = args.moe_k
+        if args.moe_group_size:
+            record["moe_group_size"] = args.moe_group_size
+        if args.moe_cf is not None:
+            record["moe_capacity_factor"] = args.moe_cf
     if args.zero1:
         record["zero1"] = True
     if args.mu_bf16:

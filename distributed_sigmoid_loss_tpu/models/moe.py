@@ -72,31 +72,50 @@ def moe_capacity(group: int, e: int, k: int, capacity_factor: float) -> int:
     return min(group, max(1, int(-(-k * group * capacity_factor // e))))
 
 
-def build_dispatch(gates: jax.Array, idx: jax.Array, e: int, capacity: int):
+def build_dispatch(
+    gates: jax.Array, idx: jax.Array, e: int, capacity: int, dtype=jnp.float32
+):
     """One-hot dispatch/combine tensors from the router's top-k choices.
 
     Slot positions via a cumulative count in choice-major order within each
     group: every token's 1st choice outranks any token's 2nd choice (GShard's
     priority rule), and within a choice earlier tokens win — all static-shape.
     Returns ``(dispatch (n,g,E,C), combine (n,g,E,C))``.
+
+    ``dtype`` is the OUTPUT dtype of the dispatch/combine tensors (the model
+    activation dtype in the layer). The slot arithmetic — the cumulative
+    count, whose values reach ``group`` and would corrupt past 256 in bf16 —
+    always runs in f32; only the one-hots and gate weights, whose exact
+    values (0/1 and softmax gates) bf16 carries fine, are emitted in
+    ``dtype``. That halves the HBM traffic of the (tokens, E, C) tensors,
+    the round-3 breakdown's "dispatch build" cost.
     """
     n_groups, group, k = idx.shape
-    choice_onehot = jax.nn.one_hot(
+    choice_f32 = jax.nn.one_hot(
         jnp.moveaxis(idx, -1, 1), e, dtype=jnp.float32
     )  # (n, k, g, E)
     position = (
-        jnp.cumsum(choice_onehot.reshape(n_groups, k * group, e), axis=1) - 1.0
+        jnp.cumsum(choice_f32.reshape(n_groups, k * group, e), axis=1) - 1.0
     ).reshape(n_groups, k, group, e)
-    slot = jnp.sum(position * choice_onehot, axis=-1).astype(jnp.int32)  # (n, k, g)
-    keep = (slot < capacity).astype(jnp.float32)
-    slot_onehot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32) * keep[
-        ..., None
-    ]  # (n, k, g, C)
+    slot = jnp.sum(position * choice_f32, axis=-1).astype(jnp.int32)  # (n, k, g)
+    choice_onehot = choice_f32.astype(dtype)
+    # Over-capacity drops come free: one_hot emits an all-zero row for any
+    # slot >= capacity (out-of-range index), so no separate keep mask exists.
+    slot_onehot = jax.nn.one_hot(slot, capacity, dtype=dtype)  # (n, k, g, C)
+    if k == 1:
+        # Switch top-1 (the headline MoE config): the (n, k, g, E, C)
+        # per-choice tensor collapses — build dispatch directly and weight by
+        # the single gate, skipping one 5-D einsum materialization.
+        dispatch = jnp.einsum(
+            "nte,ntc->ntec", choice_onehot[:, 0], slot_onehot[:, 0]
+        )
+        combine = dispatch * gates.astype(dtype)[..., 0][:, :, None, None]
+        return dispatch, combine
     # Per-choice dispatch (n, k, g, E, C); choices land in disjoint slots so
     # the sum over k is still one-hot per (E, C) slot.
     per_choice = jnp.einsum("nkte,nktc->nktec", choice_onehot, slot_onehot)
     combine = jnp.einsum(
-        "ntk,nktec->ntec", gates.astype(jnp.float32), per_choice
+        "ntk,nktec->ntec", gates.astype(dtype), per_choice
     )  # gate-weighted
     dispatch = jnp.sum(per_choice, axis=1)  # (n, g, E, C)
     return dispatch, combine
@@ -195,7 +214,9 @@ class MoeMlp(nn.Module):
 
         # --- Per-group capacity assignment ------------------------------------
         capacity = moe_capacity(group, e, k, self.capacity_factor)
-        dispatch, combine = build_dispatch(gates, idx, e, capacity)
+        dispatch, combine = build_dispatch(
+            gates, idx, e, capacity, dtype=self.dtype
+        )
 
         # --- Load-balancing auxiliary loss (Switch eq. 4, over all tokens) ----
         # f_e: fraction of tokens whose first choice is e; P_e: mean router prob.

@@ -255,3 +255,68 @@ def test_moe_grouped_routing_matches_dense():
     y, _ = m.apply({"params": params}, x, mutable=["intermediates"])
     want = _dense_reference(params, x, 1)
     np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+def _dispatch_reference(gates, idx, e, capacity):
+    """Straight-line numpy oracle of the GShard slot assignment: choice-major
+    priority within each group, earlier tokens win, over-capacity dropped."""
+    n, g, k = idx.shape
+    dispatch = np.zeros((n, g, e, capacity), np.float32)
+    combine = np.zeros((n, g, e, capacity), np.float32)
+    for ni in range(n):
+        counts = np.zeros(e, np.int64)
+        for kj in range(k):
+            for t in range(g):
+                ex = int(idx[ni, t, kj])
+                slot = counts[ex]
+                counts[ex] += 1
+                if slot < capacity:
+                    dispatch[ni, t, ex, slot] = 1.0
+                    combine[ni, t, ex, slot] = float(gates[ni, t, kj])
+    return dispatch, combine
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_build_dispatch_matches_numpy_oracle(k):
+    """Covers BOTH code paths: the k=1 fast path (no 5-D per-choice tensor)
+    and the general top-k einsum path, against an independent slot-assignment
+    oracle — including over-capacity drops."""
+    from distributed_sigmoid_loss_tpu.models.moe import build_dispatch
+
+    rng = np.random.default_rng(5)
+    n, g, e, capacity = 3, 12, 4, 3  # tight capacity: drops occur
+    idx = rng.integers(0, e, (n, g, k))
+    if k > 1:  # distinct experts per token, as top_k guarantees
+        idx[..., 1] = (idx[..., 0] + 1 + rng.integers(0, e - 1, (n, g))) % e
+    gates = rng.random((n, g, k)).astype(np.float32)
+    d_ref, c_ref = _dispatch_reference(gates, idx, e, capacity)
+    d, c = build_dispatch(
+        jnp.asarray(gates), jnp.asarray(idx), e, capacity
+    )
+    np.testing.assert_array_equal(np.asarray(d), d_ref)
+    np.testing.assert_allclose(np.asarray(c), c_ref, rtol=1e-6)
+
+
+def test_build_dispatch_bf16_keeps_f32_routing():
+    """dtype=bfloat16 emits bf16 tensors but must make the IDENTICAL routing
+    decisions (the slot arithmetic stays f32 — values reach `group`, which
+    bf16 would corrupt past 256): the dispatch one-hots are bitwise equal and
+    the combine weights differ only by bf16 rounding of the gates."""
+    from distributed_sigmoid_loss_tpu.models.moe import build_dispatch
+
+    rng = np.random.default_rng(6)
+    n, g, e, k = 2, 512, 4, 1  # group 512 > 256: the bf16-corruptible regime
+    idx = rng.integers(0, e, (n, g, k))
+    gates = rng.random((n, g, k)).astype(np.float32)
+    capacity = 160  # some drops
+    d32, c32 = build_dispatch(jnp.asarray(gates), jnp.asarray(idx), e, capacity)
+    d16, c16 = build_dispatch(
+        jnp.asarray(gates), jnp.asarray(idx), e, capacity, dtype=jnp.bfloat16
+    )
+    assert d16.dtype == jnp.bfloat16 and c16.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(d16, np.float32), np.asarray(d32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(c16, np.float32), np.asarray(c32), rtol=1e-2, atol=1e-3
+    )
