@@ -89,6 +89,26 @@ def _model_config(args):
             vision=dataclasses.replace(cfg.vision, quant=args.quant),
             text=dataclasses.replace(cfg.text, quant=args.quant),
         )
+    if getattr(args, "remat_policy", ""):
+        # Same override bench.py carries: the measured-best policies are
+        # per-model AND per-batch (docs/PERF.md round-4 sweep), so the train
+        # CLI exposes the knob rather than hard-coding one winner.
+        if not (cfg.vision.remat or cfg.text.remat):
+            # tiny_test() disables remat entirely — the policy would be
+            # silently ignored (Encoder applies it only under remat=True).
+            raise SystemExit(
+                f"--remat-policy {args.remat_policy} is a no-op for "
+                f"{name!r}: its towers run without rematerialization"
+            )
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(
+                cfg.vision, remat_policy=args.remat_policy
+            ),
+            text=dataclasses.replace(cfg.text, remat_policy=args.remat_policy),
+        )
     return cfg
 
 
@@ -1191,6 +1211,13 @@ def main(argv=None) -> int:
                     help="bf16 gradient accumulator under --accum (adds stay "
                          "f32; halves the accumulator's HBM footprint and "
                          "per-microstep read+write traffic)")
+    tr.add_argument("--remat-policy", default="",
+                    choices=["", "nothing", "save_hot", "save_all_hot",
+                             "save_mlp"],
+                    help="override both towers' remat policy (default: the "
+                         "model config's own; measured winners per shape in "
+                         "docs/PERF.md — e.g. save_hot for b16/l14 "
+                         "microbatch-128 recipes, save_mlp for so400m)")
     tr.add_argument("--accum-negatives", choices=["local", "global"],
                     default="local",
                     help="with --accum > 1: 'local' contrasts each microbatch "
