@@ -20,9 +20,16 @@ Grad oracle (tests/test_grad_compression.py): identical structure to the
 uncompressed step, per-tensor rel err < 1% single-shot and unbiased over
 steps with error feedback.
 
+Gradient accumulation (``accum_steps > 1``) composes the natural way for a
+compressed link: microbatch grads accumulate LOCALLY (no comm), and the
+psum + compressed DCN exchange run ONCE on the accumulated mean — so the
+slow-wire bytes per optimizer step are the same as an unaccumulated step's,
+i.e. M× fewer per sample. (The regular step's autodiff-inserted psum rides
+every microstep's backward instead.) ``accum_dtype="bfloat16"`` carries the
+local accumulator in bf16, same contract as the regular step's.
+
 v1 scope: dense towers, ``variant="all_gather"`` (the ring's ppermute has no
-joint-axis form), no accumulation/pp/MoE — each raises with a pointer to the
-regular step.
+joint-axis form), no pp/MoE — each raises with a pointer to the regular step.
 """
 
 from __future__ import annotations
@@ -40,6 +47,10 @@ from distributed_sigmoid_loss_tpu.parallel.compression import (
 )
 from distributed_sigmoid_loss_tpu.train.train_step import (
     TrainState,
+    accum_add,
+    accum_finish,
+    accum_zeros,
+    validate_accum_args,
     zero1_constrain,
 )
 from distributed_sigmoid_loss_tpu.utils.config import LossConfig
@@ -69,6 +80,8 @@ def make_compressed_train_step(
     compression: str = "int8",
     topk_frac: float = 0.01,
     topk_approximate: bool = True,
+    accum_steps: int = 1,
+    accum_dtype: str | None = None,
 ):
     """Build ``(state, batch) -> (state, metrics)`` with int8 DCN grad sync.
 
@@ -83,7 +96,14 @@ def make_compressed_train_step(
     error feedback; the step refuses topk without it).
     ``topk_approximate=False`` uses exact ``lax.top_k`` selection (CLI:
     ``--topk-exact``) — 4x slower on TPU, for bit-reproducibility needs.
+
+    ``accum_steps > 1`` scans microbatches per device and syncs the
+    ACCUMULATED mean once — per-microbatch negatives stay global over the
+    whole (dcn, dp) world (each microstep's loss all-gathers embeddings),
+    but the compressed gradient hop happens once per optimizer step.
+    ``accum_dtype`` = the regular step's bf16-accumulator contract.
     """
+    acc_dt = validate_accum_args(accum_steps, accum_dtype)
     if compression == "topk" and not error_feedback:
         raise ValueError(
             "compression='topk' without error feedback silently drops "
@@ -114,9 +134,38 @@ def make_compressed_train_step(
         return per_shard(zimg, ztxt, lp["t_prime"], lp["bias"]), lp
 
     def grads_body(params, images, tokens, ef):
-        (ell, lp), grads = jax.value_and_grad(local_loss, has_aux=True)(
-            params, images, tokens
-        )
+        if accum_steps == 1:
+            (ell, lp), grads = jax.value_and_grad(local_loss, has_aux=True)(
+                params, images, tokens
+            )
+        else:
+            # Local microbatch scan: contiguous per-device chunks (composition
+            # is arbitrary for accumulation). Each microstep still all-gathers
+            # EMBEDDINGS (global negatives, KBs); the params-sized gradient
+            # sync — the psum + compressed DCN hop below — runs once on the
+            # accumulated mean.
+            local_b = images.shape[0]
+            if local_b % accum_steps:
+                raise ValueError(
+                    f"per-device batch {local_b} must divide by "
+                    f"accum_steps={accum_steps}"
+                )
+            ims = images.reshape(accum_steps, -1, *images.shape[1:])
+            tks = tokens.reshape(accum_steps, -1, *tokens.shape[1:])
+
+            def body(carry, mb):
+                loss_sum, gsum = carry
+                (ell_i, lp_i), g = jax.value_and_grad(
+                    local_loss, has_aux=True
+                )(params, *mb)
+                return (loss_sum + ell_i, accum_add(gsum, g)), lp_i
+
+            (loss_sum, gsum), lps = lax.scan(
+                body, (jnp.zeros(()), accum_zeros(params, acc_dt)), (ims, tks)
+            )
+            ell = loss_sum / accum_steps
+            grads = accum_finish(gsum, params, scale=accum_steps)
+            lp = jax.tree.map(lambda x: x[-1], lps)
         n_dp = lax.axis_size(axis)
         # Reference-style explicit DP sync (= all_reduce(SUM)/W), split by
         # link: f32 psum-mean on ICI; compressed_axis_mean is itself a MEAN

@@ -111,6 +111,44 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(optax.clip_by_global_norm(1.0), opt)
 
 
+def validate_accum_args(accum_steps: int, accum_dtype: str | None):
+    """Shared accum contract (regular + compressed steps): returns the
+    accumulator dtype (None = param dtype). Refuse, don't drop: an
+    unaccumulated step has no accumulator, and a config claiming accum_dtype
+    that never ran poisons comparisons."""
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if accum_dtype is not None and accum_steps == 1:
+        raise ValueError(
+            f"accum_dtype={accum_dtype!r} requires accum_steps > 1 "
+            f"(got {accum_steps}); the unaccumulated step has no accumulator"
+        )
+    return jnp.dtype(accum_dtype) if accum_dtype is not None else None
+
+
+def accum_zeros(params, acc_dt):
+    """Zeroed gradient accumulator in ``acc_dt`` (None = param dtype)."""
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dt or p.dtype), params)
+
+
+def accum_add(acc, g):
+    """Upcast-add-round: the sum itself stays f32 per microstep even when the
+    carried accumulator is bf16 — THE bf16-accumulator rounding contract
+    (tests/test_train_step.py::test_bf16_accumulator_tracks_f32)."""
+    return jax.tree.map(
+        lambda a, g_: (a.astype(g_.dtype) + g_).astype(a.dtype), acc, g
+    )
+
+
+def accum_finish(acc, params, scale=None):
+    """Back to param dtype, optionally divided by ``scale`` (the microstep
+    count, when the carried value is a sum rather than a mean)."""
+    return jax.tree.map(
+        lambda a, p: (a.astype(p.dtype) / scale if scale else a.astype(p.dtype)),
+        acc, params,
+    )
+
+
 def _mean_moe_aux(variables) -> jax.Array:
     """Mean over every sown router aux scalar (scanned encoders sow one
     (depth,) leaf per tower; unrolled ones sow per-layer scalars). Filter by
@@ -419,31 +457,7 @@ def make_train_step(
     # accum_steps == 1 with "global" is not an error — an unaccumulated step
     # already contrasts globally — it just takes the plain path.
     cached_accum = accum_negatives == "global" and accum_steps > 1
-    if accum_dtype is not None and accum_steps == 1:
-        # Refuse, don't drop: an unaccumulated step has no accumulator, and a
-        # config claiming accum_dtype that never ran poisons comparisons.
-        raise ValueError(
-            f"accum_dtype={accum_dtype!r} requires accum_steps > 1 "
-            f"(got {accum_steps}); the unaccumulated step has no accumulator"
-        )
-    acc_dt = jnp.dtype(accum_dtype) if accum_dtype is not None else None
-
-    def _accum_zeros(params):
-        return jax.tree.map(
-            lambda p: jnp.zeros(p.shape, acc_dt or p.dtype), params
-        )
-
-    def _accum_add(acc, g):
-        # Upcast-add-round: the sum itself stays f32 per microstep.
-        return jax.tree.map(
-            lambda a, g_: (a.astype(g_.dtype) + g_).astype(a.dtype), acc, g
-        )
-
-    def _accum_finish(acc, params, scale=None):
-        return jax.tree.map(
-            lambda a, p: (a.astype(p.dtype) / scale if scale else a.astype(p.dtype)),
-            acc, params,
-        )
+    acc_dt = validate_accum_args(accum_steps, accum_dtype)
     if cached_accum and pp_microbatches:
         raise ValueError(
             "accum_negatives='global' with pp_microbatches is not supported "
@@ -585,10 +599,12 @@ def make_train_step(
             (_, aux_), g = jax.value_and_grad(surrogate, has_aux=True)(
                 params, mb, g_zi, g_zt
             )
-            return _accum_add(grad_sum, g), aux_
+            return accum_add(grad_sum, g), aux_
 
-        grads, auxs = lax.scan(body, _accum_zeros(params), (micro, g_zis, g_zts))
-        grads = _accum_finish(grads, params)
+        grads, auxs = lax.scan(
+            body, accum_zeros(params, acc_dt), (micro, g_zis, g_zts)
+        )
+        grads = accum_finish(grads, params)
         mean_aux = jnp.mean(auxs)
         if moe_aux_weight is not None:
             # The optimized objective includes the aux term; report the same
@@ -623,14 +639,14 @@ def make_train_step(
             (loss, (lp, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, mb
             )
-            carry = (loss_sum + loss, _accum_add(grad_sum, grads))
+            carry = (loss_sum + loss, accum_add(grad_sum, grads))
             return carry, (lp, aux)
 
         (loss_sum, grad_sum), (lps, auxs) = lax.scan(
-            body, (jnp.zeros(()), _accum_zeros(params)), micro
+            body, (jnp.zeros(()), accum_zeros(params, acc_dt)), micro
         )
         lp = jax.tree.map(lambda x: x[-1], lps)
-        grads = _accum_finish(grad_sum, params, scale=accum_steps)
+        grads = accum_finish(grad_sum, params, scale=accum_steps)
         return loss_sum / accum_steps, lp, jnp.mean(auxs), grads
 
     def step(state: TrainState, batch: dict):

@@ -402,3 +402,121 @@ def test_compressed_requires_allgather_variant():
             _tiny_model_and_batch()[0], hybrid_mesh(),
             LossConfig(variant="ring"),
         )
+
+
+def test_compressed_accum_matches_mean_of_microbatch_steps():
+    """Accumulation oracle for the compressed step: under sgd, the accum-2
+    param delta must equal the MEAN of the two single-microbatch compressed
+    deltas (same contiguous-local-chunk composition the scan uses), within
+    stacked int8 quantization error — compression is applied to the mean on
+    one side and per-term on the other, each within ~1% of the exact value.
+    Loss must be the exact mean of the per-microbatch global losses."""
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_compressed_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig
+
+    mesh = hybrid_mesh()  # (dcn 2, dp 4) = 8 devices
+    model, batch = _tiny_model_and_batch()  # b = 16 rows
+    tx = optax.sgd(1.0)  # delta = -grad exactly
+    cfg = LossConfig(variant="all_gather")
+    accum = 2
+    world = 8
+    local_b = batch["images"].shape[0] // world  # 2
+    local_mb = local_b // accum  # 1
+
+    step_acc, shard = make_compressed_train_step(
+        model, mesh, cfg, error_feedback=False, accum_steps=accum,
+    )
+    step_one, _ = make_compressed_train_step(
+        model, mesh, cfg, error_feedback=False,
+    )
+
+    def fresh():
+        return create_train_state(jax.random.key(0), model, tx, batch, mesh)
+
+    p0 = jax.tree.map(jnp.copy, fresh().params)
+    state_acc, m_acc = step_acc(fresh(), jax.device_put(batch, shard))
+
+    # Microbatch m as its own global batch: device d's m-th local chunk.
+    deltas, losses = [], []
+    for m in range(accum):
+        rows = np.concatenate([
+            np.arange(d * local_b + m * local_mb,
+                      d * local_b + (m + 1) * local_mb)
+            for d in range(world)
+        ])
+        mb = jax.tree.map(lambda x: x[rows], batch)
+        st, mm = step_one(fresh(), jax.device_put(mb, shard))
+        losses.append(float(mm["loss"]))
+        deltas.append(jax.tree.map(lambda a, b: a - b, st.params, p0))
+
+    np.testing.assert_allclose(
+        float(m_acc["loss"]), np.mean(losses), rtol=1e-5
+    )
+    expected = jax.tree.map(lambda a, b: (a + b) / 2, *deltas)
+    got = jax.tree.map(lambda a, b: a - b, state_acc.params, p0)
+    for dg, de in zip(jax.tree.leaves(got), jax.tree.leaves(expected)):
+        scale = float(jnp.max(jnp.abs(de)))
+        if scale < 1e-8:
+            continue  # zero-gradient directions: roundoff, not signal
+        rel = float(jnp.max(jnp.abs(dg - de))) / scale
+        assert rel < 0.04, rel
+
+
+def test_compressed_accum_descends_and_bf16_tracks_f32():
+    """The accumulated compressed step trains, with int8+EF; the bf16
+    accumulator variant follows the f32 one to bf16 round-off."""
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_compressed_train_step,
+        with_error_feedback,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig
+
+    mesh = hybrid_mesh()
+    model, batch = _tiny_model_and_batch()
+    tx = optax.sgd(1e-2)
+    cfg = LossConfig(variant="all_gather")
+
+    def run(accum_dtype):
+        state = with_error_feedback(
+            create_train_state(jax.random.key(0), model, tx, batch, mesh),
+            mesh,
+        )
+        step, shard = make_compressed_train_step(
+            model, mesh, cfg, accum_steps=2, accum_dtype=accum_dtype,
+        )
+        b = jax.device_put(batch, shard)
+        losses = []
+        for _ in range(4):
+            state, m = step(state, b)
+            losses.append(float(m["loss"]))
+        return losses
+
+    losses_f32 = run(None)
+    losses_b16 = run("bfloat16")
+    assert losses_f32[-1] < losses_f32[0], losses_f32
+    np.testing.assert_allclose(losses_b16, losses_f32, rtol=5e-3)
+
+
+def test_compressed_accum_validates_args():
+    from distributed_sigmoid_loss_tpu.train import make_compressed_train_step
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.utils.config import (
+        LossConfig,
+        SigLIPConfig,
+    )
+
+    mesh = hybrid_mesh()
+    model = SigLIP(SigLIPConfig.tiny_test())
+    with pytest.raises(ValueError, match="accum_dtype"):
+        make_compressed_train_step(
+            model, mesh, LossConfig(variant="all_gather"),
+            accum_dtype="bfloat16",
+        )
+    with pytest.raises(ValueError, match="accum_steps"):
+        make_compressed_train_step(
+            model, mesh, LossConfig(variant="all_gather"), accum_steps=0,
+        )
