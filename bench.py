@@ -651,8 +651,10 @@ def run_moe_breakdown(args) -> int:
     wo = jax.random.normal(ko, (e, hidden, d), jnp.float32) * 0.02
 
     probs, gates, idx = jax.jit(lambda x, w: router_topk(x, w, k))(xg, wr)
+    # dtype=bf16: the dtype MoeMlp passes for bf16 towers (round-4
+    # model-dtype dispatch build) — the breakdown times the module's code.
     dispatch, combine = jax.jit(
-        lambda g, i: build_dispatch(g, i, e, capacity)
+        lambda g, i: build_dispatch(g, i, e, capacity, dtype=jnp.bfloat16)
     )(gates, idx)
 
     def timeit(fn, *a):
@@ -668,7 +670,10 @@ def run_moe_breakdown(args) -> int:
         jax.grad(lambda w, x: jnp.sum(router_topk(x, w, k)[1])), wr, xg
     )
     stages["dispatch_build_ms"] = timeit(
-        jax.grad(lambda g, i: jnp.sum(build_dispatch(g, i, e, capacity)[1])),
+        jax.grad(lambda g, i: jnp.sum(
+            build_dispatch(g, i, e, capacity, dtype=jnp.bfloat16)[1]
+            .astype(jnp.float32)
+        )),
         gates, idx,
     )
     stages["expert_einsums_ms"] = timeit(
@@ -684,7 +689,7 @@ def run_moe_breakdown(args) -> int:
     def full_moe(ws, x):
         w_r, w_i, w_o = ws
         _, g, i = router_topk(x, w_r, k)
-        disp, comb = build_dispatch(g, i, e, capacity)
+        disp, comb = build_dispatch(g, i, e, capacity, dtype=jnp.bfloat16)
         y = expert_apply(x, disp, comb, w_i, w_o, jnp.bfloat16)
         return jnp.sum(y.astype(jnp.float32) ** 2)
 

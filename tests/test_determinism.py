@@ -47,9 +47,9 @@ def test_compressed_training_run_bitwise_reproducible():
     cfg = SigLIPConfig.tiny_test()
     mesh = make_2d_mesh(2, 4, axis_names=("dcn", "dp"))
     model = SigLIP(cfg)
-    batch = tiny_batch(8, cfg)
+    batch = tiny_batch(16, cfg)  # 2 rows/device: admits the accum-2 variant
 
-    def run(compression):
+    def run(compression, accum=1):
         tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=100))
         state = with_error_feedback(
             create_train_state(jax.random.key(0), model, tx, batch, mesh),
@@ -57,7 +57,8 @@ def test_compressed_training_run_bitwise_reproducible():
         )
         step, shardings = make_compressed_train_step(
             model, mesh, LossConfig(variant="all_gather"),
-            compression=compression,
+            compression=compression, accum_steps=accum,
+            accum_dtype="bfloat16" if accum > 1 else None,
         )
         b = jax.device_put(batch, shardings)
         for _ in range(3):
@@ -68,10 +69,10 @@ def test_compressed_training_run_bitwise_reproducible():
             float(metrics["loss"]),
         )
 
-    for compression in ("int8", "topk"):
-        p1, e1, l1 = run(compression)
-        p2, e2, l2 = run(compression)
-        assert l1 == l2, compression
+    for compression, accum in (("int8", 1), ("topk", 1), ("int8", 2)):
+        p1, e1, l1 = run(compression, accum)
+        p2, e2, l2 = run(compression, accum)
+        assert l1 == l2, (compression, accum)
         jax.tree.map(
             lambda a, b: np.testing.assert_array_equal(
                 np.asarray(a), np.asarray(b)
