@@ -405,13 +405,6 @@ def cmd_train(args) -> int:
                            "no joint-(dcn,dp) axis form)")
         if args.pp > 1 or args.ep > 1 or args.moe_experts:
             reasons.append("dense non-pipelined towers (no --pp/--ep/--moe-*)")
-        if args.accum > 1 and args.accum_negatives != "local":
-            # The compressed accum scan contrasts each microbatch against the
-            # same-microstep WORLD embeddings (1/M of the full-batch negative
-            # set); the GradCache-exact path is not implemented for it.
-            reasons.append("--accum-negatives local (GradCache-exact "
-                           "full-batch negatives are not implemented for the "
-                           "compressed accumulation scan)")
         if args.ema_decay is not None:
             reasons.append("no --ema-decay")
         if args.grad_compression == "topk" and not (0 < args.topk_frac <= 1):
@@ -623,6 +616,7 @@ def cmd_train(args) -> int:
             topk_approximate=not args.topk_exact,
             accum_steps=args.accum,
             accum_dtype="bfloat16" if args.accum_bf16 else None,
+            accum_negatives=args.accum_negatives,
         )
     else:
         step_fn, shardings = make_train_step(

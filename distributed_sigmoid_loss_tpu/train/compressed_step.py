@@ -21,12 +21,18 @@ uncompressed step, per-tensor rel err < 1% single-shot and unbiased over
 steps with error feedback.
 
 Gradient accumulation (``accum_steps > 1``) composes the natural way for a
-compressed link: microbatch grads accumulate LOCALLY (no comm), and the
+compressed link: microbatch grads accumulate LOCALLY, and the params-sized
 psum + compressed DCN exchange run ONCE on the accumulated mean — so the
-slow-wire bytes per optimizer step are the same as an unaccumulated step's,
-i.e. M× fewer per sample. (The regular step's autodiff-inserted psum rides
-every microstep's backward instead.) ``accum_dtype="bfloat16"`` carries the
-local accumulator in bf16, same contract as the regular step's.
+slow-wire GRADIENT bytes per optimizer step are the same as an unaccumulated
+step's, i.e. M× fewer per sample. (The regular step's autodiff-inserted psum
+rides every microstep's backward instead.) What still crosses the wire per
+microstep is the embedding traffic: the loss all-gather and its VJP move
+(local_mb, d) tensors — KBs against the params' GBs — and with
+``accum_negatives="global"`` (GradCache-exact full-batch negatives, the
+shared ``run_gradcache`` recipe) the ONE loss island additionally routes the
+full stacked-embedding cotangents across the mesh once per step.
+``accum_dtype="bfloat16"`` carries the local accumulator in bf16, same
+contract as the regular step's.
 
 v1 scope: dense towers, ``variant="all_gather"`` (the ring's ppermute has no
 joint-axis form), no pp/MoE — each raises with a pointer to the regular step.
@@ -50,6 +56,7 @@ from distributed_sigmoid_loss_tpu.train.train_step import (
     accum_add,
     accum_finish,
     accum_zeros,
+    run_gradcache,
     validate_accum_args,
     zero1_constrain,
 )
@@ -82,6 +89,7 @@ def make_compressed_train_step(
     topk_approximate: bool = True,
     accum_steps: int = 1,
     accum_dtype: str | None = None,
+    accum_negatives: str = "local",
 ):
     """Build ``(state, batch) -> (state, metrics)`` with int8 DCN grad sync.
 
@@ -102,8 +110,21 @@ def make_compressed_train_step(
     whole (dcn, dp) world (each microstep's loss all-gathers embeddings),
     but the compressed gradient hop happens once per optimizer step.
     ``accum_dtype`` = the regular step's bf16-accumulator contract.
+
+    ``accum_negatives="global"`` (with ``accum_steps > 1``) computes the
+    EXACT full-batch loss under accumulation, GradCache-style (the regular
+    step's ``grads_and_metrics_cached`` recipe, train_step.py): embed-only
+    pass 1, ONE loss island on the full stacked tables (contrasting every
+    image against every text across microbatches AND the (dcn, dp) world),
+    then a surrogate re-forward whose parameter gradient is exactly the
+    full-batch term — still with one compressed hop per optimizer step.
     """
     acc_dt = validate_accum_args(accum_steps, accum_dtype)
+    if accum_negatives not in ("local", "global"):
+        raise ValueError(
+            f"accum_negatives must be 'local' or 'global', got {accum_negatives!r}"
+        )
+    cached_accum = accum_negatives == "global" and accum_steps > 1
     if compression == "topk" and not error_feedback:
         raise ValueError(
             "compression='topk' without error feedback silently drops "
@@ -133,8 +154,47 @@ def make_compressed_train_step(
         zimg, ztxt, lp = model.apply({"params": params}, images, tokens)
         return per_shard(zimg, ztxt, lp["t_prime"], lp["bias"]), lp
 
+    def _split_micro(images, tokens):
+        local_b = images.shape[0]
+        if local_b % accum_steps:
+            raise ValueError(
+                f"per-device batch {local_b} must divide by "
+                f"accum_steps={accum_steps}"
+            )
+        return (
+            images.reshape(accum_steps, -1, *images.shape[1:]),
+            tokens.reshape(accum_steps, -1, *tokens.shape[1:]),
+        )
+
+    def cached_grads(params, images, tokens):
+        """GradCache inside the shard_map: exact full-batch negatives.
+
+        The shared :func:`train_step.run_gradcache` recipe; here the stacked
+        loss island's per_shard contrasts over the joint (dcn, dp) axis, and
+        the per-device parameter grads feed the SAME explicit
+        psum + compressed-hop normalization chain the local path uses (the
+        surrogate identity sum_dev d<z_dev, g_dev>/dp = dL_sum/dp holds
+        device-wise, so the downstream /W normalization is unchanged).
+        """
+        ims, tks = _split_micro(images, tokens)
+
+        def stacked(zi_s, zt_s, t_prime, bias):
+            m, mb_local, d = zi_s.shape
+            return per_shard(
+                zi_s.reshape(m * mb_local, d), zt_s.reshape(m * mb_local, d),
+                t_prime, bias,
+            )
+
+        ell, lp, _, grads = run_gradcache(
+            model, params, {"images": ims, "tokens": tks}, stacked,
+            accum_steps, acc_dt,
+        )
+        return ell, lp, grads
+
     def grads_body(params, images, tokens, ef):
-        if accum_steps == 1:
+        if cached_accum:
+            ell, lp, grads = cached_grads(params, images, tokens)
+        elif accum_steps == 1:
             (ell, lp), grads = jax.value_and_grad(local_loss, has_aux=True)(
                 params, images, tokens
             )
@@ -144,14 +204,7 @@ def make_compressed_train_step(
             # EMBEDDINGS (global negatives, KBs); the params-sized gradient
             # sync — the psum + compressed DCN hop below — runs once on the
             # accumulated mean.
-            local_b = images.shape[0]
-            if local_b % accum_steps:
-                raise ValueError(
-                    f"per-device batch {local_b} must divide by "
-                    f"accum_steps={accum_steps}"
-                )
-            ims = images.reshape(accum_steps, -1, *images.shape[1:])
-            tks = tokens.reshape(accum_steps, -1, *tokens.shape[1:])
+            ims, tks = _split_micro(images, tokens)
 
             def body(carry, mb):
                 loss_sum, gsum = carry

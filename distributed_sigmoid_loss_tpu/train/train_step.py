@@ -149,6 +149,70 @@ def accum_finish(acc, params, scale=None):
     )
 
 
+def run_gradcache(
+    model, params, micro, island, accum_steps, acc_dt, moe_aux_weight=None
+):
+    """THE GradCache recipe (Gao et al. 2021), shared by the regular and
+    compressed steps so the derivation cannot drift between them.
+
+    ``micro``: dict of (M, mb, ...) arrays. ``island(zis, zts, t', b)`` is
+    the caller's full-table loss (shard_map'd stacked loss in the regular
+    step; the raw per-shard loss inside the compressed step's shard_map).
+    Returns ``(loss, lp, mean_aux, grads)``; ``loss`` excludes the aux term
+    (the caller decides whether to add it for reporting).
+
+    Pass 1 scans embeddings only (one microbatch of activations live at a
+    time; Z is (M, mb, d) f32 — megabytes). The island runs ONCE for the
+    loss value + dL/dZ + direct t_prime/bias grads. Pass 2 re-scans with the
+    surrogate ``<z_m, stop_grad(dL/dz_m)>`` (+ the direct loss-param terms
+    and the MoE aux, each 1/M per microbatch so their totals land once):
+    d(surrogate)/dparams sums to the EXACT full-batch gradient — no /M on
+    the z terms, dL/dZ already carries the scale.
+    """
+
+    def embed(_, mb):
+        zi, zt, lp_ = model.apply({"params": params}, mb["images"], mb["tokens"])
+        return None, (zi, zt, lp_)
+
+    _, (zis, zts, lps) = lax.scan(embed, None, micro)
+    lp = jax.tree.map(lambda x: x[-1], lps)
+
+    loss, island_grads = jax.value_and_grad(island, argnums=(0, 1, 2, 3))(
+        zis, zts, lp["t_prime"], lp["bias"]
+    )
+    g_zis, g_zts, g_tp, g_bias = jax.tree.map(lax.stop_gradient, island_grads)
+
+    def surrogate(p, mb, g_zi, g_zt):
+        if moe_aux_weight is None:
+            zi, zt, lp_ = model.apply({"params": p}, mb["images"], mb["tokens"])
+            aux_ = jnp.zeros(())
+        else:
+            (zi, zt, lp_), variables = model.apply(
+                {"params": p}, mb["images"], mb["tokens"],
+                mutable=["intermediates"],
+            )
+            aux_ = _mean_moe_aux(variables)
+        s = jnp.vdot(zi, g_zi) + jnp.vdot(zt, g_zt)
+        s = s + (
+            jnp.vdot(lp_["t_prime"], g_tp) + jnp.vdot(lp_["bias"], g_bias)
+        ) / accum_steps
+        if moe_aux_weight is not None:
+            s = s + moe_aux_weight * aux_ / accum_steps
+        return s, aux_
+
+    def body(grad_sum, scanned):
+        mb, g_zi, g_zt = scanned
+        (_, aux_), g = jax.value_and_grad(surrogate, has_aux=True)(
+            params, mb, g_zi, g_zt
+        )
+        return accum_add(grad_sum, g), aux_
+
+    grads, auxs = lax.scan(
+        body, accum_zeros(params, acc_dt), (micro, g_zis, g_zts)
+    )
+    return loss, lp, jnp.mean(auxs), accum_finish(grads, params)
+
+
 def _mean_moe_aux(variables) -> jax.Array:
     """Mean over every sown router aux scalar (scanned encoders sow one
     (depth,) leaf per tower; unrolled ones sow per-layer scalars). Filter by
@@ -551,61 +615,10 @@ def make_train_step(
             lambda x: microbatch_split(x, accum_steps, mesh, axis, what="accum_steps"),
             batch,
         )
-
-        # Pass 1: embeddings only. No gradients, so XLA keeps one microbatch
-        # of activations live at a time; Z is (M, mb, d) f32 — megabytes.
-        def embed(_, mb):
-            zi, zt, lp_ = model.apply(
-                {"params": params}, mb["images"], mb["tokens"]
-            )
-            return None, (zi, zt, lp_)
-
-        _, (zis, zts, lps) = lax.scan(embed, None, micro)
-        lp = jax.tree.map(lambda x: x[-1], lps)
-
-        # Loss island ONCE on the full tables: loss value + dL/dZ + the direct
-        # t_prime/bias gradients.
-        (loss, island_grads) = jax.value_and_grad(
-            lambda zi, zt, tp, b: stacked_loss(zi, zt, tp, b), argnums=(0, 1, 2, 3)
-        )(zis, zts, lp["t_prime"], lp["bias"])
-        g_zis, g_zts, g_tp, g_bias = jax.tree.map(lax.stop_gradient, island_grads)
-
-        # Pass 2: per-microbatch VJP via the surrogate <z_m, g_m> (+ the direct
-        # loss-param terms and the MoE aux, each 1/M per microbatch so their
-        # totals land once). d(surrogate)/dparams sums to the EXACT full-batch
-        # gradient — no /M on the z terms (dL/dZ already carries the scale).
-        def surrogate(p, mb, g_zi, g_zt):
-            if moe_aux_weight is None:
-                zi, zt, lp_ = model.apply(
-                    {"params": p}, mb["images"], mb["tokens"]
-                )
-                aux_ = jnp.zeros(())
-            else:
-                (zi, zt, lp_), variables = model.apply(
-                    {"params": p}, mb["images"], mb["tokens"],
-                    mutable=["intermediates"],
-                )
-                aux_ = _mean_moe_aux(variables)
-            s = jnp.vdot(zi, g_zi) + jnp.vdot(zt, g_zt)
-            s = s + (
-                jnp.vdot(lp_["t_prime"], g_tp) + jnp.vdot(lp_["bias"], g_bias)
-            ) / accum_steps
-            if moe_aux_weight is not None:
-                s = s + moe_aux_weight * aux_ / accum_steps
-            return s, aux_
-
-        def body(grad_sum, scanned):
-            mb, g_zi, g_zt = scanned
-            (_, aux_), g = jax.value_and_grad(surrogate, has_aux=True)(
-                params, mb, g_zi, g_zt
-            )
-            return accum_add(grad_sum, g), aux_
-
-        grads, auxs = lax.scan(
-            body, accum_zeros(params, acc_dt), (micro, g_zis, g_zts)
+        loss, lp, mean_aux, grads = run_gradcache(
+            model, params, micro, stacked_loss, accum_steps, acc_dt,
+            moe_aux_weight=moe_aux_weight,
         )
-        grads = accum_finish(grads, params)
-        mean_aux = jnp.mean(auxs)
         if moe_aux_weight is not None:
             # The optimized objective includes the aux term; report the same
             # loss the other paths do (metrics, divergence check, A/B curves).

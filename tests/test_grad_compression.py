@@ -520,3 +520,72 @@ def test_compressed_accum_validates_args():
         make_compressed_train_step(
             model, mesh, LossConfig(variant="all_gather"), accum_steps=0,
         )
+
+
+def test_compressed_cached_accum_matches_big_batch():
+    """THE GradCache oracle through the compressed step: accum_negatives=
+    'global' must reproduce the UNACCUMULATED compressed step on the same
+    full batch (identical negative set — the property local accumulation
+    cannot have), within int8 quantization error of the final hop. Losses
+    must match to float noise (the island computes the same full-batch
+    loss)."""
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_compressed_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig
+
+    mesh = hybrid_mesh()
+    model, batch = _tiny_model_and_batch()
+    tx = optax.sgd(1.0)
+    cfg = LossConfig(variant="all_gather")
+
+    def fresh():
+        return create_train_state(jax.random.key(0), model, tx, batch, mesh)
+
+    p0 = jax.tree.map(jnp.copy, fresh().params)
+
+    step_big, shard = make_compressed_train_step(
+        model, mesh, cfg, error_feedback=False,
+    )
+    step_cached, _ = make_compressed_train_step(
+        model, mesh, cfg, error_feedback=False,
+        accum_steps=2, accum_negatives="global",
+    )
+    step_local, _ = make_compressed_train_step(
+        model, mesh, cfg, error_feedback=False, accum_steps=2,
+    )
+    b = jax.device_put(batch, shard)
+    s_big, m_big = step_big(fresh(), b)
+    s_cached, m_cached = step_cached(fresh(), b)
+    s_local, m_local = step_local(fresh(), b)
+
+    np.testing.assert_allclose(
+        float(m_cached["loss"]), float(m_big["loss"]), rtol=1e-5
+    )
+    d_big = jax.tree.map(lambda a, b_: a - b_, s_big.params, p0)
+    d_cached = jax.tree.map(lambda a, b_: a - b_, s_cached.params, p0)
+    diffs = []
+    for dc, db in zip(jax.tree.leaves(d_cached), jax.tree.leaves(d_big)):
+        scale = float(jnp.max(jnp.abs(db)))
+        if scale < 1e-5:
+            # Mathematically-zero-gradient directions (attn k.bias: softmax
+            # is key-shift invariant) carry only f32 noise — the two paths'
+            # noise differs, and noise/noise says nothing about parity.
+            continue
+        diffs.append(float(jnp.max(jnp.abs(dc - db))) / scale)
+        # Two independent int8 roundings (the compressed hop quantizes two
+        # numerically different exact gradients) stack to a few buckets.
+        assert diffs[-1] < 0.04, diffs[-1]
+    assert diffs, "all leaves skipped — the oracle compared nothing"
+    # And the property is non-trivial: LOCAL accumulation does NOT match the
+    # big batch (each microbatch only sees same-microstep negatives).
+    d_local = jax.tree.leaves(
+        jax.tree.map(lambda a, b_: a - b_, s_local.params, p0)
+    )
+    rel = [
+        float(jnp.max(jnp.abs(dl - db))) / max(float(jnp.max(jnp.abs(db))), 1e-8)
+        for dl, db in zip(d_local, jax.tree.leaves(d_big))
+        if float(jnp.max(jnp.abs(db))) > 1e-6
+    ]
+    assert max(rel) > 0.05, "local accum unexpectedly matched the big batch"
