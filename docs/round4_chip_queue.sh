@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-4 queued chip measurements — run when the tunnel recovers:
+#   nohup bash docs/round4_chip_queue.sh > /tmp/r4queue.log 2>&1 &
+# Ordered cheapest-first so a short recovery window still yields data.
+# NO timeouts / signals: a SIGTERM inside XLA compilation wedges the tunnel
+# (docs/PERF.md round-3 postmortem).
+cd "$(dirname "$0")/.." || exit 1
+set -x
+
+# 1. Headline + 32k-equiv confirmation (cached compiles, ~4 min).
+python bench.py
+
+# 2. MoE E=4 re-measure on the round-4 dispatch code (baseline 517).
+python bench.py 192 10 b16 --moe 4 --moe-group-size 128
+
+# 3. MoE capacity-factor sweep.
+python bench.py 192 10 b16 --moe 4 --moe-group-size 128 --moe-cf 1.0
+python bench.py 192 10 b16 --moe 4 --moe-group-size 128 --moe-cf 1.5
+
+# 4. MoE breakdown on the new dispatch build (round-3: dispatch_build 6.62 ms).
+python bench.py 288 10 b16 --moe-breakdown --moe 4
+
+# 5. Step breakdown at the new headline microstep shape (fresh compiles).
+python bench.py 128 5 b16 --step-breakdown
+
+# 6. Dense-attention A/B under the round-4 config (the top unrefuted
+#    attribution item; fresh compile — keep LAST).
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --attn-impl dense
