@@ -1,0 +1,64 @@
+#!/bin/bash
+# Round-5 chip measurement queue with tunnel-recovery retry:
+#   nohup bash docs/round5_chip_queue.sh > /tmp/r5queue2.log 2>&1 &
+#
+# The round-4 wedge persisted into round 5's start (BENCH_r04.json and the
+# round-5 first probe both report init hung past 240s), so unlike the round-4
+# queue this one WAITS for the tunnel to recover — one bounded probe per
+# cycle — then runs the measurements cheapest-first. NEVER signal a running
+# bench process: SIGTERM mid-XLA-compile wedges the tunnel (docs/PERF.md
+# round-3/4 postmortems; bench.py now enforces this in code for fresh-compile
+# configs via the detached compile shield).
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-4 queue.
+while pgrep -f round4_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+set -x
+# 1. Headline + 32k-equiv confirmation (cached compiles, ~4 min) — the
+#    round-5 gate anchor (VERDICT item 1).
+python bench.py
+# 2. MoE E=4 re-measure on the round-4 dispatch code (baseline 517,
+#    target >= 560).
+python bench.py 192 10 b16 --moe 4 --moe-group-size 128
+# 3. MoE capacity-factor sweep.
+python bench.py 192 10 b16 --moe 4 --moe-group-size 128 --moe-cf 1.0
+python bench.py 192 10 b16 --moe 4 --moe-group-size 128 --moe-cf 1.5
+# 4. MoE breakdown on the new dispatch build (round-3: dispatch_build 6.62 ms).
+python bench.py 288 10 b16 --moe-breakdown --moe 4
+# 5. Step breakdown at the new headline microstep shape (fresh compiles;
+#    shielded child).
+python bench.py 128 5 b16 --step-breakdown
+# 6. Dense-attention A/B under the round-4 config (the top unrefuted
+#    attribution item; fresh compile, shielded).
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --attn-impl dense
+# 7. GradCache-exact negatives at the headline recipe (round-4: 643.4 —
+#    the 21% exact-semantics tax VERDICT item 7 attacks).
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --accum-negatives global
+# 8. Same with the round-5 bf16 embedding stash (the item-7 lever).
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --accum-negatives global --gradcache-bf16
+# 9. Head-batched short-attention backward A/B at the headline recipe (the
+#    round-3 candidate finally implemented; fresh compile).
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --attn-bwd batched

@@ -1,0 +1,111 @@
+"""Compile-shield drill for bench.py's fresh-compile configs.
+
+Twice (rounds 3 and 4, docs/PERF.md postmortems) a SIGTERM delivered while a
+bench child was inside XLA compilation wedged the tunneled TPU backend and
+cost the round its measurement window. bench.py now enforces the
+no-signal-mid-compile rule in code: fresh-compile configs (--step-breakdown,
+--attn-impl, MoE, --context) run in a DETACHED child (own session), and a
+signaled parent emits a JSON deferral record and exits without touching the
+child. This drill proves both halves with real processes, the same way
+tests/test_multihost_process.py proves the kill -9/resume story.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    return True
+
+
+def _child_pids(pid: int) -> list[int]:
+    try:
+        with open(f"/proc/{pid}/task/{pid}/children") as f:
+            return [int(p) for p in f.read().split()]
+    except (OSError, ValueError):
+        return []
+
+
+def _wait_for_shield_child(parent, timeout_s: float = 180.0) -> int:
+    """Poll until the shield parent has spawned its detached child (the
+    handlers are armed BEFORE the spawn, so a visible child means a signal
+    now gets the deferral path). A fixed sleep raced parent startup under
+    load — observed flaking on this 1-core host."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        assert parent.poll() is None, "bench parent exited during startup"
+        kids = _child_pids(parent.pid)
+        if kids:
+            return kids[0]
+        time.sleep(0.2)
+    raise AssertionError(f"shield child did not appear within {timeout_s}s")
+
+
+@pytest.mark.smoke
+def test_sigterm_mid_compile_defers_and_leaves_child_running():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DSL_BENCH_NO_SHIELD", None)
+    env.pop("DSL_BENCH_IN_SHIELD", None)
+    # --attn-impl dense marks this a fresh-compile config -> shielded parent.
+    parent = subprocess.Popen(
+        [sys.executable, BENCH, "4", "2", "tiny", "--attn-impl", "dense"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
+    )
+    child_pid = None
+    stdout_path = None
+    try:
+        # Wait until the detached child exists (handlers armed before spawn),
+        # then signal while it is still importing jax / compiling — exactly
+        # the window the shield exists for.
+        spawned = _wait_for_shield_child(parent)
+        parent.send_signal(signal.SIGTERM)
+        out, _ = parent.communicate(timeout=30)
+        assert parent.returncode == 0  # the deferral is an orderly exit
+        rec = json.loads(out.strip().splitlines()[-1])
+        assert rec["deferred"] is True
+        assert rec["value"] == 0.0
+        assert rec["metric"] == "siglip_vittiny_train_pairs_per_sec_per_chip"
+        assert rec["signal"] == int(signal.SIGTERM)
+        child_pid = rec["child_pid"]
+        assert child_pid == spawned
+        stdout_path = rec["child_stdout"]
+        # The whole point: the signal must NOT have propagated to the child.
+        assert _pid_alive(child_pid), "shield killed the compiling child"
+        assert os.path.exists(stdout_path)
+    finally:
+        if parent.poll() is None:
+            parent.kill()
+        # CPU child: SIGKILL is safe here (no tunnel to wedge).
+        if child_pid is not None and _pid_alive(child_pid):
+            os.kill(child_pid, signal.SIGKILL)
+        if stdout_path and os.path.exists(stdout_path):
+            os.unlink(stdout_path)
+
+
+@pytest.mark.smoke
+def test_unsignaled_shield_reemits_child_record():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DSL_BENCH_NO_SHIELD", None)
+    env.pop("DSL_BENCH_IN_SHIELD", None)
+    proc = subprocess.run(
+        [sys.executable, BENCH, "4", "2", "tiny", "--attn-impl", "dense"],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "siglip_vittiny_train_pairs_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert "deferred" not in rec
