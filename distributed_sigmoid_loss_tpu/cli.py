@@ -135,11 +135,13 @@ def _make_training_mesh(args):
 
         devices = jax.devices()
         n_dev = len(devices)
-        if getattr(args, "pp", 1) > 1 or args.ep > 1:
-            return None, "--dcn-slices composes with dp only (no --pp/--ep)"
-        if n_dev % dcn:
+        pp = getattr(args, "pp", 1)
+        if args.ep > 1:
+            return None, "--dcn-slices composes with dp/pp only (no --ep)"
+        if n_dev % (dcn * pp):
             return None, (
-                f"--dcn-slices {dcn} must divide device count {n_dev}"
+                f"--dcn-slices {dcn} x --pp {pp} must divide device count "
+                f"{n_dev}"
             )
         # dcn outermost, and GROUPED BY REAL SLICE on multi-slice hardware
         # (mesh_utils.create_hybrid_device_mesh via _hybrid_device_array) —
@@ -156,23 +158,47 @@ def _make_training_mesh(args):
                     f"slice boundaries for the compression split to match "
                     f"the link topology"
                 )
-            arr = _hybrid_device_array(dcn, n_dev // dcn, 1, devices)
+            # pp rides the innermost ICI factor (stage hops are ppermute
+            # neighbor traffic); _hybrid_device_array groups by real slice.
+            arr = _hybrid_device_array(dcn, n_dev // (dcn * pp), pp, devices)
         else:
             if devices and devices[0].platform == "tpu":
                 # On real single-slice TPU hardware the 'dcn' axis lands on
                 # ICI neighbors: the int8/top-k hop pays quantization loss on
-                # a fast link with zero bandwidth win. Warn loudly — the
-                # silent plain-reshape path exists for CPU emulation, where
-                # virtual devices carry no slice metadata.
+                # a fast link with zero bandwidth win. A stderr warning is
+                # easy to lose in multi-host logs (advisor, round 4), so a
+                # production run REFUSES unless the override flag makes the
+                # emulation intent explicit. The silent plain-reshape path
+                # exists for CPU emulation, where virtual devices carry no
+                # slice metadata.
+                if not getattr(args, "force_dcn_emulation", False):
+                    return None, (
+                        f"--dcn-slices {dcn} on single-slice TPU hardware: "
+                        "the 'dcn' axis maps onto ICI neighbors, so "
+                        "compressed gradient sync pays quantization loss on "
+                        "a fast link with no bandwidth win; pass "
+                        "--force-dcn-emulation to run it anyway (perf "
+                        "experiments emulating a multi-slice topology)"
+                    )
                 print(
                     f"WARNING: --dcn-slices {dcn} on single-slice TPU "
-                    "hardware — the 'dcn' axis maps onto ICI neighbors, so "
-                    "compressed gradient sync pays quantization loss on a "
-                    "fast link with no bandwidth win (intended for "
-                    "multi-slice DCN topologies or CPU emulation)",
+                    "hardware (--force-dcn-emulation) — compressed sync "
+                    "pays quantization loss on ICI with no bandwidth win",
                     file=sys.stderr,
                 )
             arr = np.array(devices)
+        if pp > 1:
+            from distributed_sigmoid_loss_tpu.parallel.pipeline import (
+                pipeline_axis,
+            )
+
+            return (
+                Mesh(
+                    arr.reshape(dcn, n_dev // (dcn * pp), pp),
+                    ("dcn", data_axis, pipeline_axis),
+                ),
+                None,
+            )
         return (
             Mesh(arr.reshape(dcn, n_dev // dcn), ("dcn", data_axis)),
             None,
@@ -403,8 +429,9 @@ def cmd_train(args) -> int:
         if args.variant == "ring":
             reasons.append("--variant all_gather or unset (ring ppermute has "
                            "no joint-(dcn,dp) axis form)")
-        if args.pp > 1 or args.ep > 1 or args.moe_experts:
-            reasons.append("dense non-pipelined towers (no --pp/--ep/--moe-*)")
+        if args.ep > 1 or args.moe_experts:
+            # --pp composes since round 5 (compressed_step pp_microbatches).
+            reasons.append("dense towers (no --ep/--moe-*)")
         if args.ema_decay is not None:
             reasons.append("no --ema-decay")
         if args.grad_compression == "topk" and not (0 < args.topk_frac <= 1):
@@ -592,6 +619,27 @@ def cmd_train(args) -> int:
         # Default microbatch count 2x stages: enough to keep the bubble
         # fraction (S-1)/(S+M-1) under a third without shrinking per-call work.
         pp_micro = args.pp_microbatches or 2 * args.pp
+    if args.grad_compression and pp_micro:
+        # Fail the batch-split arithmetic HERE (exit 2), not as a traceback
+        # inside the first step trace after the minutes-long state init: the
+        # compressed+pp step needs global batch = (dcn*dp) x accum x
+        # pp-microbatch rows.
+        from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis as _dax
+
+        groups = mesh.shape["dcn"] * mesh.shape[_dax]
+        ok = args.batch % groups == 0
+        local = args.batch // groups if ok else 0
+        ok = ok and local % args.accum == 0
+        micro_rows = local // args.accum if ok else 0
+        if not ok or micro_rows % pp_micro:
+            print(
+                f"--grad-compression with --pp: global batch {args.batch} "
+                f"must divide as (dcn*dp = {groups}) x accum = {args.accum} "
+                f"x pp-microbatches = {pp_micro}; "
+                f"need batch % {groups * args.accum * pp_micro} == 0",
+                file=sys.stderr,
+            )
+            return 2
     state = create_train_state(
         jax.random.key(0), model, tx, first, mesh, zero1=args.zero1,
         ema=args.ema_decay is not None, zeros=resuming,
@@ -604,20 +652,31 @@ def cmd_train(args) -> int:
         )
 
         # ef rides the live state only; checkpoints never include it (checkpoint._strip_ef), so compressed and plain runs share one checkpoint structure.
-        state = with_error_feedback(state, mesh)
-        step_fn, shardings = make_compressed_train_step(
-            model,
-            mesh,
-            LossConfig(variant="all_gather", family=args.loss_family,
-                       precision="default"),
-            zero1=args.zero1,
-            compression=args.grad_compression,
-            topk_frac=args.topk_frac,
-            topk_approximate=not args.topk_exact,
-            accum_steps=args.accum,
-            accum_dtype="bfloat16" if args.accum_bf16 else None,
-            accum_negatives=args.accum_negatives,
+        state = with_error_feedback(
+            state, mesh, pp_axis="pp" if args.pp > 1 else None
         )
+        try:
+            step_fn, shardings = make_compressed_train_step(
+                model,
+                mesh,
+                LossConfig(variant="all_gather", family=args.loss_family,
+                           precision="default"),
+                zero1=args.zero1,
+                compression=args.grad_compression,
+                topk_frac=args.topk_frac,
+                topk_approximate=not args.topk_exact,
+                accum_steps=args.accum,
+                accum_dtype="bfloat16" if args.accum_bf16 else None,
+                accum_negatives=args.accum_negatives,
+                pp_microbatches=pp_micro,
+            )
+        except ValueError as e:
+            # Tower/pp constraints (scan_layers, depth % stages, ...) surface
+            # as exit-2 config errors, not tracebacks — same contract as the
+            # regular --pp path's validate_pp_tower handling.
+            print(f"--grad-compression with --pp {args.pp}: {e}",
+                  file=sys.stderr)
+            return 2
     else:
         step_fn, shardings = make_train_step(
             model,
@@ -1272,6 +1331,10 @@ def main(argv=None) -> int:
                     help="multi-slice topology: a separate dcn mesh axis of "
                          "size N outermost (cross-slice DCN links), dp inside "
                          "(ICI) — pair with --grad-compression")
+    tr.add_argument("--force-dcn-emulation", action="store_true",
+                    help="allow --dcn-slices on single-slice TPU hardware "
+                         "(quantization loss on ICI, no bandwidth win — for "
+                         "perf experiments emulating a multi-slice topology)")
     tr.add_argument("--grad-compression", choices=["int8", "topk"],
                     default="",
                     help="compress the gradient sync over the dcn axis: f32 "

@@ -99,6 +99,27 @@ def make_layer_stage_fn(layer_apply: Callable[[Any, jax.Array], jax.Array]) -> C
     return stage_fn
 
 
+def _psum_replicate(x: jax.Array, axis_name: str) -> jax.Array:
+    """``lax.psum`` whose backward is identity — for ``check_vma=False`` regions.
+
+    The masked output collect (``psum(where(stage == last, out, 0))``) relies
+    on the vma-TYPED transpose of a variant→invariant psum, which is identity
+    per device (each device's cotangent flows to its own operand). Under an
+    enclosing ``check_vma=False`` shard_map the unchecked transpose re-psums
+    the cotangent instead — an S-fold overcount, since every pp plane's
+    identical downstream loss copy would then contribute once per plane
+    (measured: exactly 2x block grads at S=2). The custom VJP pins the
+    per-plane semantics.
+    """
+
+    @jax.custom_vjp
+    def f(v):
+        return lax.psum(v, axis_name)
+
+    f.defvjp(lambda v: (lax.psum(v, axis_name), None), lambda _, ct: (ct,))
+    return f(x)
+
+
 def _input_conveyor(xs_home, stage, axis_name, num_stages, num_micro):
     """The just-in-time input conveyor shared by ``gpipe(stream_io=True)`` and
     ``one_f_one_b(stream_inputs=True)`` (both consume microbatch ``t`` at
@@ -144,6 +165,7 @@ def gpipe(
     axis_name: str = pipeline_axis,
     checkpoint_stages: bool = False,
     stream_io: bool = False,
+    enclosing_manual: bool = False,
 ) -> jax.Array:
     """Run ``microbatches`` through ``num_stages`` pipelined stages; returns outputs.
 
@@ -171,6 +193,15 @@ def gpipe(
         so streaming costs ZERO extra ticks, just 2 activation-sized hops per
         tick riding the same ICI links as the stage boundary).
 
+      enclosing_manual: the caller is ALREADY inside a ``shard_map`` manual
+        over ``axis_name`` (e.g. the compressed train step's fully-manual
+        ``(dcn, dp, pp)`` region — nested shard_maps over disjoint axis sets
+        are not supported, so the device-level schedule is entered directly).
+        ``stage_params`` leaves must then be this device's LOCAL stage slice
+        (``(layers_per_stage, ...)``, no leading stage dim) and
+        ``microbatches`` the local ``(M, mb_local, ...)`` block, replicated
+        over ``axis_name``; outputs come back replicated the same way.
+
     Returns:
       ``(M, mb, ...)`` outputs of the full S-stage stack — replicated over
       ``pp`` normally, sharded over ``pp`` on the M dim under ``stream_io``.
@@ -187,9 +218,13 @@ def gpipe(
         stage_fn = jax.checkpoint(stage_fn)
 
     def device_fn(params, xs):
-        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        # params: this stage's LOCAL (layers_per_stage, ...) slice.
         stage = lax.axis_index(axis_name)
-        xs = pvary(xs, axis_name)
+        if not enclosing_manual:
+            # Under an enclosing check_vma=False region the vma machinery is
+            # off and pcast's typed transpose would reject the untyped
+            # cotangents; the wrapped path needs the varying mark for scan.
+            xs = pvary(xs, axis_name)
         # Ring buffer carrying the stage boundary + the output accumulator
         # (zeros_like the varying xs, so both are varying too).
         act0 = jnp.zeros_like(xs[0])
@@ -223,7 +258,8 @@ def gpipe(
         )
         # Only the last stage holds real outputs; the masked psum replicates them
         # to every stage (its transpose feeds cotangents back to the last stage).
-        return lax.psum(
+        collect = _psum_replicate if enclosing_manual else lax.psum
+        return collect(
             jnp.where(stage == num_stages - 1, out, jnp.zeros_like(out)), axis_name
         )
 
@@ -275,6 +311,14 @@ def gpipe(
         )
         return out_local
 
+    if enclosing_manual:
+        if stream_io:
+            raise ValueError(
+                "enclosing_manual with stream_io is not supported (the "
+                "streamed buffers' pp sharding would have to be expressed in "
+                "the ENCLOSING shard_map's specs); use stream_io=False"
+            )
+        return device_fn(stage_params, microbatches)
     if stream_io:
         return jax.shard_map(
             device_fn_streamed,
@@ -283,8 +327,13 @@ def gpipe(
             out_specs=P(axis_name),
             axis_names={axis_name},
         )(stage_params, microbatches)
+
+    def device_fn_sliced(params, xs):
+        # shard_map's P(axis_name) in_spec delivers a leading size-1 stage dim.
+        return device_fn(jax.tree.map(lambda p: jnp.squeeze(p, 0), params), xs)
+
     return jax.shard_map(
-        device_fn,
+        device_fn_sliced,
         mesh=mesh,
         in_specs=(P(axis_name), P()),
         out_specs=P(),

@@ -98,8 +98,18 @@ def _pipelined_blocks(
     num_microbatches: int,
     causal: bool = False,
     axis_name: str = pipeline_axis,
+    enclosing_manual: bool = False,
 ) -> jax.Array:
-    """Run the (depth,)-stacked block params over ``x`` via the gpipe schedule."""
+    """Run the (depth,)-stacked block params over ``x`` via the gpipe schedule.
+
+    ``enclosing_manual``: caller is already inside a shard_map manual over
+    ``axis_name`` (and possibly data axes — the compressed step's
+    ``(dcn, dp, pp)`` region). ``block_params`` leaves are then the LOCAL
+    stage slice ``(depth/S, ...)`` and ``x`` the local batch rows; the
+    microbatch split is a plain contiguous reshape (rows are already
+    device-local, so the GSPMD-interleaved split is unnecessary) and gpipe
+    runs its device-level schedule directly.
+    """
     num_stages = mesh.shape[axis_name]
     dtype = _dtype(cfg.dtype)
     block = Block(
@@ -118,6 +128,27 @@ def _pipelined_blocks(
             prevent_cse=False,
         )
     stage_fn = make_layer_stage_fn(layer_apply)
+    if enclosing_manual:
+        # Local stage slice arrives pre-sliced by the enclosing shard_map's
+        # P(pp) in_spec; sanity-check it is one stage's worth of layers.
+        local_depth = jax.tree.leaves(block_params)[0].shape[0]
+        if local_depth * num_stages != cfg.depth:
+            raise ValueError(
+                f"enclosing_manual expects per-stage block params "
+                f"(depth/S = {cfg.depth // num_stages} layers), got leading "
+                f"dim {local_depth}"
+            )
+        if x.shape[0] % num_microbatches:
+            raise ValueError(
+                f"local batch {x.shape[0]} must divide into "
+                f"{num_microbatches} pp microbatches"
+            )
+        xs = x.reshape((num_microbatches, -1) + x.shape[1:])
+        ys = gpipe(
+            stage_fn, block_params, xs, mesh=mesh, axis_name=axis_name,
+            stream_io=False, enclosing_manual=True,
+        )
+        return ys.reshape((-1,) + x.shape[1:])
     stage_params = stack_stage_params(block_params, num_stages)
     # Row order is preserved: split -> pipeline -> exact-inverse merge, so the
     # loss's positive-pair diagonal survives the microbatching.
@@ -140,6 +171,7 @@ def vision_forward_pp(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = pipeline_axis,
+    enclosing_manual: bool = False,
 ) -> jax.Array:
     """ViT forward ≡ ``models.vit.ViT.__call__`` with pipelined blocks.
 
@@ -158,6 +190,7 @@ def vision_forward_pp(
     x = _pipelined_blocks(
         cfg, params["encoder"]["blocks"]["block"], x,
         mesh=mesh, num_microbatches=num_microbatches, axis_name=axis_name,
+        enclosing_manual=enclosing_manual,
     )
     x = nn.LayerNorm(dtype=dtype).apply(
         {"params": params["encoder"]["ln_final"]}, x
@@ -183,6 +216,7 @@ def text_forward_pp(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = pipeline_axis,
+    enclosing_manual: bool = False,
 ) -> jax.Array:
     """Text forward ≡ ``models.text.TextTransformer.__call__`` with pipelined
     blocks."""
@@ -196,7 +230,7 @@ def text_forward_pp(
     x = _pipelined_blocks(
         cfg, params["encoder"]["blocks"]["block"], x,
         mesh=mesh, num_microbatches=num_microbatches, causal=cfg.causal,
-        axis_name=axis_name,
+        axis_name=axis_name, enclosing_manual=enclosing_manual,
     )
     x = nn.LayerNorm(dtype=dtype).apply(
         {"params": params["encoder"]["ln_final"]}, x
@@ -220,19 +254,23 @@ def siglip_forward_pp(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = pipeline_axis,
+    enclosing_manual: bool = False,
 ):
     """Drop-in for ``SigLIP.apply``: ``(zimg, ztxt, loss_params)`` with both
-    towers' blocks pipelined over ``axis_name``."""
+    towers' blocks pipelined over ``axis_name``. ``enclosing_manual``: see
+    :func:`_pipelined_blocks` — the compressed step's fully-manual region."""
     zimg = l2_normalize(
         vision_forward_pp(
             cfg.vision, params["visual"], images,
             mesh=mesh, num_microbatches=num_microbatches, axis_name=axis_name,
+            enclosing_manual=enclosing_manual,
         )
     )
     ztxt = l2_normalize(
         text_forward_pp(
             cfg.text, params["textual"], token_ids,
             mesh=mesh, num_microbatches=num_microbatches, axis_name=axis_name,
+            enclosing_manual=enclosing_manual,
         )
     )
     return zimg, ztxt, {"t_prime": params["t_prime"], "bias": params["bias"]}

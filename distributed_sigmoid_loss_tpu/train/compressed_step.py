@@ -34,8 +34,19 @@ full stacked-embedding cotangents across the mesh once per step.
 ``accum_dtype="bfloat16"`` carries the local accumulator in bf16, same
 contract as the regular step's.
 
-v1 scope: dense towers, ``variant="all_gather"`` (the ring's ppermute has no
-joint-axis form), no pp/MoE — each raises with a pointer to the regular step.
+Pipeline composition (``pp_microbatches > 0``): both towers' block stacks run
+the GPipe schedule over the mesh's ``pp`` axis INSIDE the same fully-manual
+region — the shard_map manualizes ``(dcn, dp, pp)`` jointly and
+``siglip_forward_pp(enclosing_manual=True)`` enters gpipe's device-level
+schedule directly (nested shard_maps over disjoint axis sets are not
+supported). Stage params enter pre-sliced by per-leaf ``P(pp)`` in_specs, the
+error-feedback tree shards ``(dcn, pp)`` on block leaves, and the compressed
+DCN hop quantizes each device's LOCAL stage slice — the pod-realistic pairing
+of a multi-slice wire with deep pipelined towers.
+
+Scope: ``variant="all_gather"`` (the ring's ppermute has no joint-axis form),
+dense towers (no MoE), and ``accum_negatives="global"`` not under pp (same
+constraint as the regular step) — each raises with a pointer.
 """
 
 from __future__ import annotations
@@ -56,6 +67,7 @@ from distributed_sigmoid_loss_tpu.train.train_step import (
     accum_add,
     accum_finish,
     accum_zeros,
+    is_pp_block_leaf,
     run_gradcache,
     validate_accum_args,
     zero1_constrain,
@@ -65,14 +77,29 @@ from distributed_sigmoid_loss_tpu.utils.config import LossConfig
 __all__ = ["make_compressed_train_step", "with_error_feedback"]
 
 
-def with_error_feedback(state: TrainState, mesh: Mesh, dcn_axis: str = "dcn"):
-    """Attach a zeroed error-feedback tree to ``state``, sharded over dcn."""
+def with_error_feedback(
+    state: TrainState, mesh: Mesh, dcn_axis: str = "dcn",
+    pp_axis: str | None = None,
+):
+    """Attach a zeroed error-feedback tree to ``state``, sharded over dcn.
+
+    ``pp_axis``: for a pipeline-composed compressed step
+    (``make_compressed_train_step(pp_microbatches=...)``) — block-stack
+    residuals additionally shard their depth dim over that axis, matching the
+    stage-local gradient slices the step compresses.
+    """
     n = mesh.shape[dcn_axis]
+    pp_size = mesh.shape[pp_axis] if pp_axis else 1
+
+    def shard_for(path, p):
+        if pp_axis and is_pp_block_leaf(path, p.shape, pp_size):
+            # EF leaf is (n_dcn, depth, ...): dcn on dim 0, pp on the depth dim.
+            return NamedSharding(mesh, P(dcn_axis, pp_axis))
+        return NamedSharding(mesh, P(dcn_axis))
+
     ef = jax.jit(
         lambda p: init_error_feedback(p, n),
-        out_shardings=jax.tree.map(
-            lambda _: NamedSharding(mesh, P(dcn_axis)), state.params
-        ),
+        out_shardings=jax.tree_util.tree_map_with_path(shard_for, state.params),
     )(state.params)
     return state.replace(ef=ef)
 
@@ -90,6 +117,7 @@ def make_compressed_train_step(
     accum_steps: int = 1,
     accum_dtype: str | None = None,
     accum_negatives: str = "local",
+    pp_microbatches: int = 0,
 ):
     """Build ``(state, batch) -> (state, metrics)`` with int8 DCN grad sync.
 
@@ -118,6 +146,17 @@ def make_compressed_train_step(
     image against every text across microbatches AND the (dcn, dp) world),
     then a surrogate re-forward whose parameter gradient is exactly the
     full-batch term — still with one compressed hop per optimizer step.
+
+    ``pp_microbatches > 0`` runs both towers' block stacks through the GPipe
+    schedule over the mesh's ``pp`` axis with that many microbatches per
+    (accumulation) microstep — the compressed analogue of
+    ``make_train_step(pp_microbatches=...)``. ``mesh`` must carry
+    ``(dcn, dp, pp)``; create the state with
+    ``create_train_state(..., pp_axis="pp")`` and
+    ``with_error_feedback(..., pp_axis="pp")`` so stage params and EF
+    residuals live pp-sharded. Composes with ``accum_steps`` (each
+    accumulation microbatch is itself pipelined); dense scan-layer towers
+    only, ``accum_negatives="global"`` excluded (same as the regular step).
     """
     acc_dt = validate_accum_args(accum_steps, accum_dtype)
     if accum_negatives not in ("local", "global"):
@@ -125,6 +164,35 @@ def make_compressed_train_step(
             f"accum_negatives must be 'local' or 'global', got {accum_negatives!r}"
         )
     cached_accum = accum_negatives == "global" and accum_steps > 1
+    if pp_microbatches < 0:
+        raise ValueError(f"pp_microbatches must be >= 0, got {pp_microbatches}")
+    pp_size = 1
+    if pp_microbatches:
+        from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
+        from distributed_sigmoid_loss_tpu.parallel.pp_towers import (
+            validate_pp_tower,
+        )
+
+        if cached_accum:
+            raise ValueError(
+                "accum_negatives='global' with pp_microbatches is not "
+                "supported (the pp forward is already whole-batch per "
+                "accumulation step — same constraint as make_train_step)"
+            )
+        if zero1:
+            raise ValueError(
+                "zero1 with pp_microbatches is not supported (see "
+                "make_train_step's rationale: the constrain would reshard "
+                "stage-local moments dp-wise every step)"
+            )
+        if pipeline_axis not in mesh.axis_names:
+            raise ValueError(
+                f"pp_microbatches={pp_microbatches} needs a mesh with a "
+                f"{pipeline_axis!r} axis, got {mesh.axis_names}"
+            )
+        pp_size = dict(mesh.shape)[pipeline_axis]
+        validate_pp_tower(model.cfg.vision, pp_size, "vision")
+        validate_pp_tower(model.cfg.text, pp_size, "text")
     if compression == "topk" and not error_feedback:
         raise ValueError(
             "compression='topk' without error feedback silently drops "
@@ -151,7 +219,19 @@ def make_compressed_train_step(
         # Per-DEVICE loss only — collectives live in per_shard (whose
         # all_gather/VJP route cross-device cotangents); no pmean here (its
         # transpose under check_vma=False is psum — a W-times overcount).
-        zimg, ztxt, lp = model.apply({"params": params}, images, tokens)
+        if pp_microbatches:
+            from distributed_sigmoid_loss_tpu.parallel.pp_towers import (
+                siglip_forward_pp,
+            )
+
+            # Device-level gpipe schedule over the pp axis of THIS manual
+            # region; params arrive stage-pre-sliced via the P(pp) in_specs.
+            zimg, ztxt, lp = siglip_forward_pp(
+                model.cfg, params, images, tokens, mesh=mesh,
+                num_microbatches=pp_microbatches, enclosing_manual=True,
+            )
+        else:
+            zimg, ztxt, lp = model.apply({"params": params}, images, tokens)
         return per_shard(zimg, ztxt, lp["t_prime"], lp["bias"]), lp
 
     def _split_micro(images, tokens):
@@ -231,27 +311,39 @@ def make_compressed_train_step(
         loss = lax.pmean(lax.pmean(ell, axis), dcn_axis)
         return loss, lp, grads, new_ef
 
-    ef_spec = P(dcn_axis)
     data_spec = P((dcn_axis, axis))
-    # The synced grads/loss ARE replicated (post-gather identical on every
-    # member) but vma inference cannot prove it through the dequantized
-    # mean; unchecked like the loss island (parallel/api.py).
-    if error_feedback:
-        sharded_grads = jax.shard_map(
-            grads_body,
-            mesh=mesh,
-            in_specs=(P(), data_spec, data_spec, ef_spec),
-            out_specs=(P(), P(), P(), ef_spec),
-            check_vma=False,
+
+    def _param_specs(params):
+        """Per-leaf manual specs: block stacks shard their depth dim over pp
+        (stage-local slices inside the manual region), everything else
+        replicates. Without pp this collapses to the plain P() prefix."""
+        if not pp_microbatches:
+            return P()
+        from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
+
+        return jax.tree_util.tree_map_with_path(
+            lambda path, p: (
+                P(pipeline_axis)
+                if is_pp_block_leaf(path, p.shape, pp_size)
+                else P()
+            ),
+            params,
         )
-    else:
-        # No EF tree in flight at all: compressed_axis_mean's ef=None path.
-        sharded_grads = jax.shard_map(
-            lambda p, im, tk: grads_body(p, im, tk, None)[:3],
-            mesh=mesh,
-            in_specs=(P(), data_spec, data_spec),
-            out_specs=(P(), P(), P()),
-            check_vma=False,
+
+    def _ef_specs(ef):
+        if not pp_microbatches:
+            return P(dcn_axis)
+        from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
+
+        # EF leaves are (n_dcn, *param.shape): dcn on dim 0; block leaves'
+        # depth dim (now dim 1) additionally over pp, mirroring _param_specs.
+        return jax.tree_util.tree_map_with_path(
+            lambda path, e: (
+                P(dcn_axis, pipeline_axis)
+                if is_pp_block_leaf(path, e.shape[1:], pp_size)
+                else P(dcn_axis)
+            ),
+            ef,
         )
 
     def step(state: TrainState, batch: dict):
@@ -260,11 +352,33 @@ def make_compressed_train_step(
                 "error_feedback=True but state.ef is None — create the state "
                 "with with_error_feedback(state, mesh)"
             )
+        # Specs depend on the param tree structure (per-leaf pp placement), so
+        # the shard_map is built at trace time. The synced grads/loss ARE
+        # replicated (post-gather identical on every member) but vma inference
+        # cannot prove it through the dequantized mean; unchecked like the
+        # loss island (parallel/api.py).
+        pspec = _param_specs(state.params)
         if error_feedback:
+            efspec = _ef_specs(state.ef)
+            sharded_grads = jax.shard_map(
+                grads_body,
+                mesh=mesh,
+                in_specs=(pspec, data_spec, data_spec, efspec),
+                out_specs=(P(), P(), pspec, efspec),
+                check_vma=False,
+            )
             loss, lp, grads, new_ef = sharded_grads(
                 state.params, batch["images"], batch["tokens"], state.ef
             )
         else:
+            # No EF tree in flight at all: compressed_axis_mean's ef=None path.
+            sharded_grads = jax.shard_map(
+                lambda p, im, tk: grads_body(p, im, tk, None)[:3],
+                mesh=mesh,
+                in_specs=(pspec, data_spec, data_spec),
+                out_specs=(P(), P(), pspec),
+                check_vma=False,
+            )
             loss, lp, grads = sharded_grads(
                 state.params, batch["images"], batch["tokens"]
             )

@@ -31,8 +31,20 @@ from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
 
 __all__ = [
     "make_optimizer", "create_train_state", "init_params", "make_train_step",
-    "zero1_constrain", "TrainState",
+    "zero1_constrain", "is_pp_block_leaf", "TrainState",
 ]
+
+
+def is_pp_block_leaf(path, shape, pp_size: int) -> bool:
+    """THE criterion for pipeline-stage-sharded param leaves — shared by
+    :func:`_with_pp_shardings` (regular step) and the compressed step's
+    per-leaf manual specs so the two can never drift: nn.scan-stacked block
+    leaves (path contains 'blocks') whose leading depth dim splits over
+    ``pp_size`` stages."""
+    in_blocks = any(getattr(k, "key", None) == "blocks" for k in path)
+    return bool(
+        in_blocks and shape and shape[0] >= pp_size and shape[0] % pp_size == 0
+    )
 
 
 class TrainState(train_state.TrainState):
@@ -150,7 +162,8 @@ def accum_finish(acc, params, scale=None):
 
 
 def run_gradcache(
-    model, params, micro, island, accum_steps, acc_dt, moe_aux_weight=None
+    model, params, micro, island, accum_steps, acc_dt, moe_aux_weight=None,
+    embed_dtype=None,
 ):
     """THE GradCache recipe (Gao et al. 2021), shared by the regular and
     compressed steps so the derivation cannot drift between them.
@@ -168,10 +181,20 @@ def run_gradcache(
     and the MoE aux, each 1/M per microbatch so their totals land once):
     d(surrogate)/dparams sums to the EXACT full-batch gradient — no /M on
     the z terms, dL/dZ already carries the scale.
+
+    ``embed_dtype`` (e.g. ``"bfloat16"``) stores the stashed embedding tables
+    in that dtype: the island's matmuls read bf16 operands (the MXU's native
+    gear) and the resident stash halves. The loss value and dL/dZ then carry
+    bf16 input rounding (~2^-9 relative on unit-norm embeddings) — the pass-2
+    parameter gradients stay exact w.r.t. those cotangents. Default None
+    keeps the f32 exactness-oracle contract.
     """
 
     def embed(_, mb):
         zi, zt, lp_ = model.apply({"params": params}, mb["images"], mb["tokens"])
+        if embed_dtype is not None:
+            zi = zi.astype(embed_dtype)
+            zt = zt.astype(embed_dtype)
         return None, (zi, zt, lp_)
 
     _, (zis, zts, lps) = lax.scan(embed, None, micro)
@@ -311,8 +334,7 @@ def _with_pp_shardings(
     size = dict(mesh.shape)[pp_axis]
 
     def fix(path, a, s):
-        in_blocks = any(getattr(k, "key", None) == "blocks" for k in path)
-        if in_blocks and a.shape and a.shape[0] >= size and a.shape[0] % size == 0:
+        if is_pp_block_leaf(path, a.shape, size):
             rest = tuple(s.spec)[1:]
             return NamedSharding(mesh, P(pp_axis, *rest))
         return s
@@ -422,6 +444,7 @@ def make_train_step(
     pp_microbatches: int = 0,
     accum_negatives: str = "local",
     accum_dtype: str | None = None,
+    gradcache_embed_dtype: str | None = None,
 ):
     """Build the jitted ``(state, batch) -> (state, metrics)`` step.
 
@@ -476,6 +499,12 @@ def make_train_step(
     ``pp_axis="pp"`` so stage params live sharded. Composes with dp (batch
     stays dp-sharded) and with ``accum_steps`` (each accumulation microbatch is
     itself pipelined); dense towers only.
+
+    ``gradcache_embed_dtype`` (e.g. ``"bfloat16"``, with
+    ``accum_negatives="global"``) stores the GradCache embedding stash in that
+    dtype — see :func:`run_gradcache`; attacks the exact-negatives path's
+    bandwidth share of its ~21% tax (docs/PERF.md) at the cost of bf16
+    rounding on the island's loss/cotangents.
     """
     cfg = getattr(model, "cfg", None)
     for tower in ("vision", "text"):
@@ -522,6 +551,12 @@ def make_train_step(
     # already contrasts globally — it just takes the plain path.
     cached_accum = accum_negatives == "global" and accum_steps > 1
     acc_dt = validate_accum_args(accum_steps, accum_dtype)
+    if gradcache_embed_dtype is not None and not cached_accum:
+        raise ValueError(
+            f"gradcache_embed_dtype={gradcache_embed_dtype!r} requires "
+            "accum_negatives='global' with accum_steps > 1 (only the "
+            "GradCache path stashes embedding tables)"
+        )
     if cached_accum and pp_microbatches:
         raise ValueError(
             "accum_negatives='global' with pp_microbatches is not supported "
@@ -617,7 +652,7 @@ def make_train_step(
         )
         loss, lp, mean_aux, grads = run_gradcache(
             model, params, micro, stacked_loss, accum_steps, acc_dt,
-            moe_aux_weight=moe_aux_weight,
+            moe_aux_weight=moe_aux_weight, embed_dtype=gradcache_embed_dtype,
         )
         if moe_aux_weight is not None:
             # The optimized objective includes the aux term; report the same

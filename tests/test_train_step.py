@@ -385,3 +385,43 @@ def test_cached_accumulation_validates_inputs():
     model = SigLIP(cfg)
     with pytest.raises(ValueError, match="accum_negatives"):
         make_train_step(model, mesh, LossConfig(), accum_negatives="bogus")
+
+
+def test_gradcache_bf16_stash_tracks_f32():
+    """gradcache_embed_dtype='bfloat16' (the round-5 lever on the GradCache
+    tax) must track the f32 stash: same loss to bf16 input rounding, same
+    updates to the island-cotangent rounding; refused outside the GradCache
+    path (an unstashed step has no stash to downcast)."""
+    import optax
+
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    mesh = make_mesh(4)
+    tx = optax.sgd(1.0)  # params expose the grads directly
+    batch = tiny_batch(16, cfg)
+    state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+    lc = LossConfig(variant="ring")
+    kw = dict(accum_steps=4, accum_negatives="global")
+    step_f32, shardings = make_train_step(model, mesh, lc, **kw)
+    step_b16, _ = make_train_step(
+        model, mesh, lc, gradcache_embed_dtype="bfloat16", **kw
+    )
+    batch = jax.device_put(batch, shardings)
+    copy = lambda s_: jax.tree.map(jnp.copy, s_)
+    s32, m32 = step_f32(copy(state), batch)
+    s16, m16 = step_b16(copy(state), batch)
+    # bf16 keeps ~2^-9 relative on the unit-norm embedding tables; the loss
+    # and dL/dZ inherit that, the pass-2 param grads inherit dL/dZ's.
+    np.testing.assert_allclose(float(m16["loss"]), float(m32["loss"]), rtol=5e-3)
+    for a, b, p0 in zip(
+        jax.tree.leaves(s16.params),
+        jax.tree.leaves(s32.params),
+        jax.tree.leaves(state.params),
+    ):
+        g32 = np.asarray(p0 - b)
+        atol = max(2e-5, float(np.max(np.abs(g32))) * 2 ** -7)
+        np.testing.assert_allclose(np.asarray(p0 - a), g32, rtol=5e-2, atol=atol)
+    with pytest.raises(ValueError, match="gradcache_embed_dtype"):
+        make_train_step(
+            model, mesh, LossConfig(), gradcache_embed_dtype="bfloat16"
+        )
