@@ -415,6 +415,13 @@ def cmd_train(args) -> int:
               "forward is already whole-batch per accumulation step)",
               file=sys.stderr)
         return 2
+    if args.gradcache_bf16 and (
+        args.accum == 1 or args.accum_negatives != "global"
+    ):
+        print("--gradcache-bf16 requires --accum > 1 with --accum-negatives "
+              "global (only the GradCache path stashes embedding tables)",
+              file=sys.stderr)
+        return 2
     if args.dcn_slices > 1 and not args.grad_compression:
         print("--dcn-slices without --grad-compression is a silent no-op: the "
               "regular step already spans slices when the dp axis is built "
@@ -429,9 +436,11 @@ def cmd_train(args) -> int:
         if args.variant == "ring":
             reasons.append("--variant all_gather or unset (ring ppermute has "
                            "no joint-(dcn,dp) axis form)")
-        if args.ep > 1 or args.moe_experts:
-            # --pp composes since round 5 (compressed_step pp_microbatches).
-            reasons.append("dense towers (no --ep/--moe-*)")
+        if args.ep > 1:
+            # --pp and --moe-experts (experts replicated, ep == 1) compose
+            # since round 5; expert PARALLELISM stays with the regular step
+            # (no GSPMD all-to-alls inside the manual region).
+            reasons.append("no --ep (expert parallelism needs the regular step)")
         if args.ema_decay is not None:
             reasons.append("no --ema-decay")
         if args.grad_compression == "topk" and not (0 < args.topk_frac <= 1):
@@ -668,7 +677,15 @@ def cmd_train(args) -> int:
                 accum_steps=args.accum,
                 accum_dtype="bfloat16" if args.accum_bf16 else None,
                 accum_negatives=args.accum_negatives,
+                gradcache_embed_dtype=(
+                    "bfloat16" if args.gradcache_bf16 else None
+                ),
                 pp_microbatches=pp_micro,
+                moe_aux_weight=(
+                    (0.01 if args.moe_aux_weight is None else args.moe_aux_weight)
+                    if args.moe_experts
+                    else None
+                ),
             )
         except ValueError as e:
             # Tower/pp constraints (scan_layers, depth % stages, ...) surface
@@ -686,6 +703,7 @@ def cmd_train(args) -> int:
             accum_steps=args.accum,
             accum_negatives=args.accum_negatives,
             accum_dtype="bfloat16" if args.accum_bf16 else None,
+            gradcache_embed_dtype="bfloat16" if args.gradcache_bf16 else None,
             zero1=args.zero1,
             ema_decay=args.ema_decay,
             moe_aux_weight=(
@@ -1286,6 +1304,11 @@ def main(argv=None) -> int:
                          "GradCache-style (embed pass + loss island + "
                          "surrogate re-forward; ~30%% slower, bitwise-faithful "
                          "negatives)")
+    tr.add_argument("--gradcache-bf16", action="store_true",
+                    help="with --accum-negatives global: store the GradCache "
+                         "embedding stash in bf16 (island matmuls read bf16 "
+                         "operands, stash HBM halves; ~2^-9 rounding on the "
+                         "island loss/cotangents)")
     tr.add_argument("--moe-experts", type=int, default=0,
                     help="swap tower MLPs for this many experts per block "
                          "(mixture-of-experts; shards over an ep mesh axis)")

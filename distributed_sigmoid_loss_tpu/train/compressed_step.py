@@ -44,9 +44,13 @@ error-feedback tree shards ``(dcn, pp)`` on block leaves, and the compressed
 DCN hop quantizes each device's LOCAL stage slice — the pod-realistic pairing
 of a multi-slice wire with deep pipelined towers.
 
+MoE towers compose on meshes WITHOUT an ``ep`` axis (``moe_aux_weight=...``;
+experts replicated — GSPMD cannot insert expert all-to-alls inside the manual
+region, so expert parallelism stays with the regular step).
+
 Scope: ``variant="all_gather"`` (the ring's ppermute has no joint-axis form),
-dense towers (no MoE), and ``accum_negatives="global"`` not under pp (same
-constraint as the regular step) — each raises with a pointer.
+``accum_negatives="global"`` not under pp, and pp towers dense (same
+constraints as the regular step) — each raises with a pointer.
 """
 
 from __future__ import annotations
@@ -64,6 +68,7 @@ from distributed_sigmoid_loss_tpu.parallel.compression import (
 )
 from distributed_sigmoid_loss_tpu.train.train_step import (
     TrainState,
+    _mean_moe_aux,
     accum_add,
     accum_finish,
     accum_zeros,
@@ -118,6 +123,8 @@ def make_compressed_train_step(
     accum_dtype: str | None = None,
     accum_negatives: str = "local",
     pp_microbatches: int = 0,
+    moe_aux_weight: float | None = None,
+    gradcache_embed_dtype: str | None = None,
 ):
     """Build ``(state, batch) -> (state, metrics)`` with int8 DCN grad sync.
 
@@ -157,6 +164,19 @@ def make_compressed_train_step(
     residuals live pp-sharded. Composes with ``accum_steps`` (each
     accumulation microbatch is itself pipelined); dense scan-layer towers
     only, ``accum_negatives="global"`` excluded (same as the regular step).
+
+    ``moe_aux_weight`` (with MoE towers, non-pp) adds that weight times the
+    mean router load-balancing loss to the objective — the regular step's
+    contract, inside the manual region (experts replicated; no ``ep`` axis).
+    Estimator note: Switch eq. 4 is a product of token-means, so the
+    per-device aux averaged across the world (what this step optimizes — the
+    DDP per-replica convention, each device balancing its local tokens) is
+    not bitwise the regular step's global-batch product; the two track within
+    a few percent and both bound expert imbalance.
+
+    ``gradcache_embed_dtype`` (e.g. ``"bfloat16"``, with
+    ``accum_negatives="global"``): store the GradCache embedding stash in
+    that dtype — :func:`train_step.run_gradcache`'s contract.
     """
     acc_dt = validate_accum_args(accum_steps, accum_dtype)
     if accum_negatives not in ("local", "global"):
@@ -164,6 +184,12 @@ def make_compressed_train_step(
             f"accum_negatives must be 'local' or 'global', got {accum_negatives!r}"
         )
     cached_accum = accum_negatives == "global" and accum_steps > 1
+    if gradcache_embed_dtype is not None and not cached_accum:
+        raise ValueError(
+            f"gradcache_embed_dtype={gradcache_embed_dtype!r} requires "
+            "accum_negatives='global' with accum_steps > 1 (only the "
+            "GradCache path stashes embedding tables)"
+        )
     if pp_microbatches < 0:
         raise ValueError(f"pp_microbatches must be >= 0, got {pp_microbatches}")
     pp_size = 1
@@ -193,6 +219,11 @@ def make_compressed_train_step(
         pp_size = dict(mesh.shape)[pipeline_axis]
         validate_pp_tower(model.cfg.vision, pp_size, "vision")
         validate_pp_tower(model.cfg.text, pp_size, "text")
+    if moe_aux_weight is not None and pp_microbatches:
+        raise ValueError(
+            "pp towers are dense (same constraint as make_train_step); "
+            "moe_aux_weight requires the non-pp compressed path"
+        )
     if compression == "topk" and not error_feedback:
         raise ValueError(
             "compression='topk' without error feedback silently drops "
@@ -230,9 +261,24 @@ def make_compressed_train_step(
                 model.cfg, params, images, tokens, mesh=mesh,
                 num_microbatches=pp_microbatches, enclosing_manual=True,
             )
-        else:
+            aux = jnp.zeros(())
+        elif moe_aux_weight is None:
             zimg, ztxt, lp = model.apply({"params": params}, images, tokens)
-        return per_shard(zimg, ztxt, lp["t_prime"], lp["bias"]), lp
+            aux = jnp.zeros(())
+        else:
+            # MoE towers: experts REPLICATED on this mesh (no ep axis inside
+            # the manual region — GSPMD can't insert expert all-to-alls
+            # here); router aux is a mean over this device's local tokens, so
+            # the explicit psum/W below makes the objective's aux term the
+            # per-replica estimator's world mean (see docstring).
+            (zimg, ztxt, lp), variables = model.apply(
+                {"params": params}, images, tokens, mutable=["intermediates"]
+            )
+            aux = _mean_moe_aux(variables)
+        loss = per_shard(zimg, ztxt, lp["t_prime"], lp["bias"])
+        if moe_aux_weight is not None:
+            loss = loss + moe_aux_weight * aux
+        return loss, (lp, aux)
 
     def _split_micro(images, tokens):
         local_b = images.shape[0]
@@ -265,19 +311,24 @@ def make_compressed_train_step(
                 t_prime, bias,
             )
 
-        ell, lp, _, grads = run_gradcache(
+        ell, lp, mean_aux, grads = run_gradcache(
             model, params, {"images": ims, "tokens": tks}, stacked,
-            accum_steps, acc_dt,
+            accum_steps, acc_dt, moe_aux_weight=moe_aux_weight,
+            embed_dtype=gradcache_embed_dtype,
         )
-        return ell, lp, grads
+        if moe_aux_weight is not None:
+            # run_gradcache's loss excludes the aux term; report the same
+            # objective the other paths do.
+            ell = ell + moe_aux_weight * mean_aux
+        return ell, lp, mean_aux, grads
 
     def grads_body(params, images, tokens, ef):
         if cached_accum:
-            ell, lp, grads = cached_grads(params, images, tokens)
+            ell, lp, aux, grads = cached_grads(params, images, tokens)
         elif accum_steps == 1:
-            (ell, lp), grads = jax.value_and_grad(local_loss, has_aux=True)(
-                params, images, tokens
-            )
+            (ell, (lp, aux)), grads = jax.value_and_grad(
+                local_loss, has_aux=True
+            )(params, images, tokens)
         else:
             # Local microbatch scan: contiguous per-device chunks (composition
             # is arbitrary for accumulation). Each microstep still all-gathers
@@ -288,17 +339,47 @@ def make_compressed_train_step(
 
             def body(carry, mb):
                 loss_sum, gsum = carry
-                (ell_i, lp_i), g = jax.value_and_grad(
+                (ell_i, (lp_i, aux_i)), g = jax.value_and_grad(
                     local_loss, has_aux=True
                 )(params, *mb)
-                return (loss_sum + ell_i, accum_add(gsum, g)), lp_i
+                return (loss_sum + ell_i, accum_add(gsum, g)), (lp_i, aux_i)
 
-            (loss_sum, gsum), lps = lax.scan(
+            (loss_sum, gsum), (lps, auxs) = lax.scan(
                 body, (jnp.zeros(()), accum_zeros(params, acc_dt)), (ims, tks)
             )
             ell = loss_sum / accum_steps
             grads = accum_finish(gsum, params, scale=accum_steps)
             lp = jax.tree.map(lambda x: x[-1], lps)
+            aux = jnp.mean(auxs)
+        if pp_microbatches:
+            from distributed_sigmoid_loss_tpu.parallel.pipeline import (
+                pipeline_axis,
+            )
+
+            # Replication repair over pp BEFORE declaring grads P()-replicated
+            # (check_vma=False verifies nothing): gpipe consumes the
+            # microbatch feed at stage 0 only, so leaves UPSTREAM of the
+            # pipeline (patch/pos/token embeddings) carry their full gradient
+            # on the stage-0 plane and exactly ZERO on every other plane,
+            # while downstream leaves are already equal everywhere. Taking
+            # the stage-0 plane's value — a masked psum — is correct for
+            # both classes uniformly. Block stacks are stage-local
+            # (pp-sharded) by design and must NOT be touched; inside the
+            # manual region their local shapes no longer satisfy the global
+            # is_pp_block_leaf shape test, so classify by path alone.
+            # Teeth: tests/test_grad_compression.py::
+            # test_compressed_pp_replicated_leaves_stay_replicated fails
+            # with this block removed.
+            on_stage0 = lax.axis_index(pipeline_axis) == 0
+
+            def repair(path, g):
+                if any(getattr(k, "key", None) == "blocks" for k in path):
+                    return g
+                return lax.psum(
+                    jnp.where(on_stage0, g, jnp.zeros_like(g)), pipeline_axis
+                )
+
+            grads = jax.tree_util.tree_map_with_path(repair, grads)
         n_dp = lax.axis_size(axis)
         # Reference-style explicit DP sync (= all_reduce(SUM)/W), split by
         # link: f32 psum-mean on ICI; compressed_axis_mean is itself a MEAN
@@ -309,7 +390,8 @@ def make_compressed_train_step(
             topk_approximate=topk_approximate,
         )
         loss = lax.pmean(lax.pmean(ell, axis), dcn_axis)
-        return loss, lp, grads, new_ef
+        aux = lax.pmean(lax.pmean(aux, axis), dcn_axis)
+        return loss, lp, aux, grads, new_ef
 
     data_spec = P((dcn_axis, axis))
 
@@ -364,22 +446,22 @@ def make_compressed_train_step(
                 grads_body,
                 mesh=mesh,
                 in_specs=(pspec, data_spec, data_spec, efspec),
-                out_specs=(P(), P(), pspec, efspec),
+                out_specs=(P(), P(), P(), pspec, efspec),
                 check_vma=False,
             )
-            loss, lp, grads, new_ef = sharded_grads(
+            loss, lp, aux, grads, new_ef = sharded_grads(
                 state.params, batch["images"], batch["tokens"], state.ef
             )
         else:
             # No EF tree in flight at all: compressed_axis_mean's ef=None path.
             sharded_grads = jax.shard_map(
-                lambda p, im, tk: grads_body(p, im, tk, None)[:3],
+                lambda p, im, tk: grads_body(p, im, tk, None)[:4],
                 mesh=mesh,
                 in_specs=(pspec, data_spec, data_spec),
-                out_specs=(P(), P(), pspec),
+                out_specs=(P(), P(), P(), pspec),
                 check_vma=False,
             )
-            loss, lp, grads = sharded_grads(
+            loss, lp, aux, grads = sharded_grads(
                 state.params, batch["images"], batch["tokens"]
             )
         state = state.apply_gradients(grads=grads)
@@ -393,6 +475,8 @@ def make_compressed_train_step(
             "bias": lp["bias"],
             "grad_norm": optax.global_norm(grads),
         }
+        if moe_aux_weight is not None:
+            metrics["moe_aux"] = aux
         if error_feedback:
             state = state.replace(ef=new_ef)
             metrics["ef_norm"] = optax.global_norm(new_ef)
