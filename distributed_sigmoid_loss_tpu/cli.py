@@ -654,6 +654,15 @@ def cmd_train(args) -> int:
         ema=args.ema_decay is not None, zeros=resuming,
         pp_axis="pp" if args.pp > 1 else None,
     )
+    # ONE resolution of the step kwargs shared by the compressed and regular
+    # branches — a default (e.g. the 0.01 router-aux weight) edited in only
+    # one branch would silently train a different objective per mode.
+    moe_aux_w = (
+        (0.01 if args.moe_aux_weight is None else args.moe_aux_weight)
+        if args.moe_experts
+        else None
+    )
+    gradcache_dt = "bfloat16" if args.gradcache_bf16 else None
     if args.grad_compression:
         from distributed_sigmoid_loss_tpu.train import (
             make_compressed_train_step,
@@ -677,15 +686,9 @@ def cmd_train(args) -> int:
                 accum_steps=args.accum,
                 accum_dtype="bfloat16" if args.accum_bf16 else None,
                 accum_negatives=args.accum_negatives,
-                gradcache_embed_dtype=(
-                    "bfloat16" if args.gradcache_bf16 else None
-                ),
+                gradcache_embed_dtype=gradcache_dt,
                 pp_microbatches=pp_micro,
-                moe_aux_weight=(
-                    (0.01 if args.moe_aux_weight is None else args.moe_aux_weight)
-                    if args.moe_experts
-                    else None
-                ),
+                moe_aux_weight=moe_aux_w,
             )
         except ValueError as e:
             # Tower/pp constraints (scan_layers, depth % stages, ...) surface
@@ -703,14 +706,10 @@ def cmd_train(args) -> int:
             accum_steps=args.accum,
             accum_negatives=args.accum_negatives,
             accum_dtype="bfloat16" if args.accum_bf16 else None,
-            gradcache_embed_dtype="bfloat16" if args.gradcache_bf16 else None,
+            gradcache_embed_dtype=gradcache_dt,
             zero1=args.zero1,
             ema_decay=args.ema_decay,
-            moe_aux_weight=(
-                (0.01 if args.moe_aux_weight is None else args.moe_aux_weight)
-                if args.moe_experts
-                else None
-            ),
+            moe_aux_weight=moe_aux_w,
             pp_microbatches=pp_micro,
         )
 

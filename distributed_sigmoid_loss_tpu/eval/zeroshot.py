@@ -138,5 +138,11 @@ def zeroshot_metrics(
     if mesh is None:
         ranks = classify_ranks(zimg, classifier, labels)
     else:
+        # The classifier often arrives as a slice/derivation of sharded
+        # embeddings (committed to some data sharding); the ranks jit pins it
+        # replicated, so re-place it — a no-op when already replicated.
+        classifier = jax.device_put(
+            classifier, NamedSharding(mesh, P())
+        )
         ranks = _ranks_fn(mesh, axis_name)(zimg, classifier, labels)
     return {f"top@{k}": jnp.mean(ranks < k) for k in ks}

@@ -86,5 +86,63 @@ def main():
         )
 
 
+def main_pp():
+    """Scenario 2 (round 5): the same compressed wire with both towers
+    PIPELINED over a pp axis — a (dcn 2, dp 2, pp 2) mesh. Stage params and
+    error-feedback residuals live pp-sharded; gpipe's schedule runs inside
+    the same fully-manual region as the compressed hop. CLI equivalent:
+
+        python -m distributed_sigmoid_loss_tpu train --cpu-devices 8 --tiny \\
+            --dcn-slices 2 --pp 2 --grad-compression int8 --steps 20 --batch 16
+    """
+    import dataclasses
+
+    from jax.sharding import Mesh
+
+    mesh = Mesh(
+        np.array(jax.devices()).reshape(2, 2, 2), ("dcn", "dp", "pp")
+    )
+    cfg = SigLIPConfig.tiny_test()
+    # Pipeline stages are the nn.scan-stacked block params.
+    cfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, scan_layers=True),
+        text=dataclasses.replace(cfg.text, scan_layers=True),
+    )
+    model = SigLIP(cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": jnp.asarray(
+            rng.standard_normal(
+                (16, cfg.vision.image_size, cfg.vision.image_size, 3)
+            ),
+            jnp.float32,
+        ),
+        "tokens": jnp.asarray(
+            rng.integers(0, cfg.text.vocab_size, (16, cfg.text.context_length)),
+            jnp.int32,
+        ),
+    }
+    state = with_error_feedback(
+        create_train_state(
+            jax.random.key(0), model, optax.adam(3e-3), batch, mesh,
+            pp_axis="pp",
+        ),
+        mesh, pp_axis="pp",
+    )
+    step, shardings = make_compressed_train_step(
+        model, mesh, LossConfig(variant="all_gather"), compression="int8",
+        pp_microbatches=2,
+    )
+    b = jax.device_put(batch, shardings)
+    for i in range(6):
+        state, m = step(state, b)
+        print(
+            f"pp step {i + 1:2d}  loss={float(m['loss']):7.4f}  "
+            f"ef_norm={float(m['ef_norm']):.3e}"
+        )
+
+
 if __name__ == "__main__":
     main()
+    main_pp()

@@ -5,6 +5,7 @@ compiled program — which these tests pin down.
 """
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -97,6 +98,7 @@ def test_streamed_gpipe_bitwise_matches_replicated():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.standard
 def test_training_run_bitwise_reproducible():
     """Two independent 3-step runs from the same seed produce identical params."""
     cfg = SigLIPConfig.tiny_test()

@@ -33,6 +33,7 @@ def hybrid_mesh(dcn=2, dp=4):
     return Mesh(devs, ("dcn", "dp"))
 
 
+@pytest.mark.standard
 def test_quantize_roundtrip_bound():
     rng = np.random.default_rng(0)
     t = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
@@ -43,6 +44,7 @@ def test_quantize_roundtrip_bound():
     assert float(err) <= float(s) * 0.5 + 1e-7
 
 
+@pytest.mark.standard
 def test_compressed_mean_matches_exact_mean():
     mesh = hybrid_mesh()
     rng = np.random.default_rng(1)
@@ -64,6 +66,7 @@ def test_compressed_mean_matches_exact_mean():
     assert rel < 0.02, rel
 
 
+@pytest.mark.standard
 def test_error_feedback_telescopes():
     """Sum of K synced means tracks the exact sum to one-shot error, not K x."""
     mesh = hybrid_mesh()
@@ -144,6 +147,7 @@ def _states_and_steps(mesh, error_feedback=True):
     return state_c, state_u, step_c, step_u, shard_c, shard_u, batch
 
 
+@pytest.mark.standard
 def test_compressed_step_grads_match_uncompressed():
     """Under sgd, the one-step param delta IS -lr*grad: compare deltas leaf by
     leaf between the compressed and uncompressed steps (same init, same
@@ -230,7 +234,7 @@ def test_cli_train_compressed_smoke():
     assert all("ef_norm" in r and "loss" in r for r in recs)
 
 
-def test_compressed_moe_matches_regular_and_descends():
+def test_compressed_moe_matches_regular():
     """MoE towers (experts replicated, no ep axis) under the compressed step:
     the router aux rides the objective inside the manual region. Oracle: same
     structure as test_compressed_step_grads_match_uncompressed — the regular
@@ -370,6 +374,7 @@ def test_topk_sparsify_roundtrip():
     )
 
 
+@pytest.mark.standard
 def test_topk_mean_with_full_k_is_exact():
     """topk at k=100% must reduce to the exact mean (the sparsification is
     lossless when nothing is dropped)."""
@@ -664,6 +669,7 @@ def _pp_model_and_batch():
     return SigLIP(cfg), batch
 
 
+@pytest.mark.standard
 def test_compressed_pp_step_matches_non_pp():
     """Pipeline composition oracle: the compressed step with both towers
     pipelined over pp=2 (a (dcn 2, dp 2, pp 2) mesh) must reproduce the
@@ -757,6 +763,7 @@ def test_compressed_pp_composes_with_accum_and_ef():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.standard
 def test_compressed_pp_replicated_leaves_stay_replicated():
     """EVERY pp plane must hold the same value for every non-block param
     leaf after a compressed+pp step. gpipe consumes the microbatch feed at

@@ -48,6 +48,11 @@ def test_dryrun_runs_in_process_when_devices_available(monkeypatch):
     # With the backend live at >= n devices, no subprocess may be spawned.
     import subprocess
 
+    # numpy imports numpy.testing LAZILY on first attribute access, and that
+    # import probes SVE support via a subprocess ('lscpu') — pre-import it so
+    # the monkeypatch below only sees subprocesses the dryrun itself spawns.
+    import numpy.testing  # noqa: F401
+
     def _boom(*a, **k):  # pragma: no cover - would indicate a regression
         raise AssertionError("dryrun_multichip spawned a subprocess unnecessarily")
 

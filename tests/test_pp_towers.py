@@ -63,6 +63,7 @@ def tiny_batch(global_b, cfg, seed=0):
     # micro=8/4: the streamed conveyor path (gpipe stream_io).
     [(2, 4, 2), (1, 2, 3), (2, 4, 4), (1, 2, 4)],
 )
+@pytest.mark.standard
 def test_pp_forward_matches_plain(dp, pp, micro):
     cfg = pp_config()
     model = SigLIP(cfg)

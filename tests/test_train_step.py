@@ -60,6 +60,7 @@ def test_train_step_runs_and_learns(variant):
     assert float(metrics["grad_norm"]) > 0
 
 
+@pytest.mark.standard
 def test_train_step_2d_mesh_tensor_parallel():
     """dp=2 × tp=2: tower kernels sharded over tp, batch over dp."""
     cfg = SigLIPConfig.tiny_test()
@@ -81,6 +82,7 @@ def test_train_step_2d_mesh_tensor_parallel():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.standard
 def test_train_matches_single_device_reference():
     """Grad-parity of the full step: 4-way sharded step == unsharded step (one step of
     the same batch from the same init must produce the same loss and params)."""
@@ -106,6 +108,7 @@ def test_train_matches_single_device_reference():
     )
 
 
+@pytest.mark.standard
 def test_grad_accumulation_matches_mean_of_microbatch_grads():
     """accum_steps=2 with sgd(1.0) must land exactly at params - mean(microbatch
     grads): the update itself proves the gradient averaging, not just the loss."""
@@ -387,6 +390,7 @@ def test_cached_accumulation_validates_inputs():
         make_train_step(model, mesh, LossConfig(), accum_negatives="bogus")
 
 
+@pytest.mark.standard
 def test_gradcache_bf16_stash_tracks_f32():
     """gradcache_embed_dtype='bfloat16' (the round-5 lever on the GradCache
     tax) must track the f32 stash: same loss to bf16 input rounding, same

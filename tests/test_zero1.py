@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributed_sigmoid_loss_tpu.data import put_batch
@@ -68,6 +69,7 @@ def test_zero1_numerics_match_replicated():
     )
 
 
+@pytest.mark.standard
 def test_zero1_moments_are_dp_sharded_after_steps():
     mesh = make_mesh(8)
     state, _ = _setup(mesh, zero1=True)
