@@ -50,7 +50,11 @@ region, so expert parallelism stays with the regular step).
 
 Scope: ``variant="all_gather"`` (the ring's ppermute has no joint-axis form),
 ``accum_negatives="global"`` not under pp, and pp towers dense (same
-constraints as the regular step) — each raises with a pointer.
+constraints as the regular step) — each raises with a pointer. Sequence
+parallelism stays with the regular step by design: sp's economics depend on
+GSPMD propagating the sequence sharding through the non-attention tower ops
+(MLP/LN run on seq shards), which a fully-manual region cannot provide — a
+manual sp composition would replicate that compute sp-fold.
 """
 
 from __future__ import annotations

@@ -1,4 +1,6 @@
 #!/bin/bash
+# SUPERSEDED (round 5): docs/round5_chip_queue.sh waits for tunnel recovery
+# itself and covers this list plus the round-5 items — use that one.
 # Round-4 queued chip measurements — run when the tunnel recovers:
 #   nohup bash docs/round4_chip_queue.sh > /tmp/r4queue.log 2>&1 &
 # Ordered cheapest-first so a short recovery window still yields data.
