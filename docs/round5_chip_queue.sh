@@ -62,3 +62,9 @@ python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
 #    round-3 candidate finally implemented; fresh compile).
 python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
   --remat-policy save_hot --attn-bwd batched
+# 10. Family re-confirmations at the round-4 winning recipes (round-4 numbers
+#     were self-reported only; these bank driver-visible records).
+python bench.py 512 5 l14 --accum 8 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot
+python bench.py 1024 5 so400m --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_mlp
