@@ -154,6 +154,55 @@ def emit_backend_error(args, error: str) -> None:
         "steps": args.steps,
     }))
 
+def _attn_bwd_record_fields(args) -> dict:
+    """attn_bwd record fields from the kernel choice ACTUALLY resolved at
+    trace time, cross-checked against argv.
+
+    set_bwd_batch_heads is process-global state baked in per trace: a step
+    traced before the flip keeps the other kernel while argv still says
+    ``--attn-bwd batched`` — trusting argv could log an A/B record for a
+    kernel that never ran (advisor, round 5). The traced record is the truth;
+    argv mismatches are flagged in the record AND on stderr so the datapoint
+    never silently enters a per-metric stream under the wrong tag.
+    """
+    from distributed_sigmoid_loss_tpu.ops.pallas_short_attention import (
+        traced_bwd_batch_heads,
+    )
+
+    want = args.attn_bwd
+    traced = traced_bwd_batch_heads()
+    if not traced:
+        # No fused short-attention backward traced at all (dense/flash path,
+        # or a forward-only mode): a non-default request was a no-op.
+        if want == "loop":
+            return {}
+        print(
+            f"WARNING: --attn-bwd {want} requested but no fused "
+            "short-attention backward was traced; tagging the record "
+            "attn_bwd_traced=none",
+            file=sys.stderr,
+        )
+        return {"attn_bwd": want, "attn_bwd_traced": "none",
+                "attn_bwd_mismatch": True}
+    if len(traced) > 1:
+        actual = "mixed"
+    else:
+        actual = "batched" if traced[0] else "loop"
+    fields = {}
+    if actual != "loop":
+        fields["attn_bwd"] = actual
+    if actual != want:
+        print(
+            f"WARNING: --attn-bwd {want} but the traced backward kernel was "
+            f"{actual!r} — recording the traced choice",
+            file=sys.stderr,
+        )
+        fields["attn_bwd"] = actual
+        fields["attn_bwd_argv"] = want
+        fields["attn_bwd_mismatch"] = True
+    return fields
+
+
 def _fresh_compile_config(args) -> bool:
     """Configs whose jitted programs are NOT in the warm persistent-compile
     cache of routine headline runs — the ones a stray SIGTERM can catch inside
@@ -167,7 +216,55 @@ def _fresh_compile_config(args) -> bool:
         or args.attn_impl != "auto"
         or args.text_attn_impl
         or args.attn_bwd != "loop"
+        # GradCache configs build a different program than the headline step
+        # (embed scan + loss island + surrogate re-forward), and the bf16
+        # stash variant differs again — neither sits in the warm cache.
+        or args.accum_negatives != "local"
+        or args.gradcache_bf16
     )
+
+
+def _shield_signal_record(args, child, out, errf, metric, unit, signum) -> None:
+    """Emit the right record for a signal that reached the shield PARENT.
+
+    Child still running → the "left running" deferral (never signal a process
+    that may be inside XLA compilation). Child already exited (the signal
+    landed after wait() returned, or in the wait→handler-restore window) →
+    the NORMAL path instead: relay its JSON records, or a backend-error
+    record noting it had already exited — a deferral there would name a dead,
+    possibly recycled, pid (advisor, round 5). The caller exits afterwards;
+    this helper only decides what lands on stdout.
+    """
+    rc = child.poll() if child is not None else None
+    if rc is not None:
+        try:
+            out.flush()
+            out.seek(0)
+            n = _emit_valid_json_lines(out.read())
+        except (OSError, ValueError):
+            n = 0
+        if n == 0:
+            emit_backend_error(
+                args,
+                f"signal {int(signum)} after shielded child already exited "
+                f"rc={rc} with no JSON record (child stdout kept at "
+                f"{out.name}, stderr at {errf.name})",
+            )
+        return
+    print(json.dumps({
+        "metric": metric,
+        "value": 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "deferred": True,
+        "signal": int(signum),
+        "child_pid": child.pid if child is not None else None,
+        "child_stdout": out.name,
+        "child_stderr": errf.name,
+        "error": "signal during a fresh-compile bench: child left "
+                 "running detached (signaling mid-XLA-compile wedges "
+                 "the tunnel); its JSON record lands in child_stdout",
+    }), flush=True)
 
 
 def run_shielded(args, argv: list[str]) -> int:
@@ -205,21 +302,8 @@ def run_shielded(args, argv: list[str]) -> int:
     child = None  # set after spawn; the handler tolerates a pre-spawn signal
 
     def on_signal(signum, frame):
-        print(json.dumps({
-            "metric": metric,
-            "value": 0.0,
-            "unit": unit,
-            "vs_baseline": 0.0,
-            "deferred": True,
-            "signal": int(signum),
-            "child_pid": child.pid if child is not None else None,
-            "child_stdout": out.name,
-            "child_stderr": errf.name,
-            "error": "signal during a fresh-compile bench: child left "
-                     "running detached (signaling mid-XLA-compile wedges "
-                     "the tunnel); its JSON record lands in child_stdout",
-        }), flush=True)
-        os._exit(0)  # exit WITHOUT signaling the child
+        _shield_signal_record(args, child, out, errf, metric, unit, signum)
+        os._exit(0)  # exit WITHOUT signaling the (possibly live) child
 
     # Handlers armed BEFORE the spawn: a signal in the spawn window must
     # still produce a deferral record, never a silent rc=-15. (The only
@@ -750,8 +834,7 @@ def run_step_breakdown(args) -> int:
     }
     if args.mu_bf16:
         record["adam_mu_dtype"] = "bfloat16"
-    if args.attn_bwd != "loop":
-        record["attn_bwd"] = args.attn_bwd
+    record.update(_attn_bwd_record_fields(args))
     print(json.dumps(record))
     return 0
 
@@ -1347,8 +1430,7 @@ def main():
         record["attn_impl"] = args.attn_impl
     if args.text_attn_impl:
         record["text_attn_impl"] = args.text_attn_impl
-    if args.attn_bwd != "loop":
-        record["attn_bwd"] = args.attn_bwd
+    record.update(_attn_bwd_record_fields(args))
     if args.moe:
         record["moe_experts"] = args.moe
         record["moe_num_selected"] = args.moe_k

@@ -1,0 +1,103 @@
+"""jax version compatibility shims, applied once at package import.
+
+The framework targets jax >= 0.6; older installs (0.4.x) spell two of the
+APIs it leans on differently:
+
+- ``jax.shard_map`` lives at ``jax.experimental.shard_map.shard_map`` with
+  the replication check named ``check_rep`` instead of 0.6's ``check_vma``.
+  A keyword-translating wrapper is aliased onto the ``jax`` namespace (every
+  call site here uses the ``mesh=/in_specs=/out_specs=`` keyword form).
+- ``jax.lax.axis_size(name)`` (static size of a bound mesh axis) does not
+  exist; ``jax.core.axis_frame(name)`` returns exactly that int there.
+- ``jax.lax.pvary`` (explicit replicated→varying cast, required by 0.6's
+  strict vma typing) has no 0.4.x equivalent BECAUSE the old ``check_rep``
+  machinery infers rep-ness itself — the identity is the faithful shim.
+
+Shims install only when the modern symbol is missing — no-op on jax >= 0.6.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.core
+import jax.distributed
+import jax.lax
+
+
+def install() -> None:
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map as _exp_shard_map
+        except ImportError:  # pragma: no cover - nothing to shim with
+            _exp_shard_map = None
+        if _exp_shard_map is not None:
+
+            def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                          check_vma=True, axis_names=None, **kw):
+                if mesh is None:
+                    # 0.6 resolves the ambient mesh itself; 0.4.x needs it
+                    # explicit — pull it from the Mesh context manager.
+                    from jax._src.mesh import thread_resources
+
+                    ambient = thread_resources.env.physical_mesh
+                    mesh = None if ambient.empty else ambient
+                if axis_names is not None and mesh is not None:
+                    kw.setdefault(
+                        "auto",
+                        frozenset(mesh.axis_names) - frozenset(axis_names),
+                    )
+                return _exp_shard_map(
+                    f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_rep=check_vma, **kw,
+                )
+
+            jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+
+        def axis_size(axis_name):
+            return jax.core.axis_frame(axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    if not (hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast")):
+
+        def pvary(x, axis_name):
+            return x
+
+        jax.lax.pvary = pvary
+
+    if not hasattr(jax, "set_mesh"):
+        # 0.4.x Mesh is itself the ambient-mesh context manager; returning it
+        # makes ``with jax.set_mesh(mesh):`` behave like 0.6's context form.
+        def set_mesh(mesh):
+            return mesh
+
+        jax.set_mesh = set_mesh
+
+    if not hasattr(jax, "shard_map") or jax.shard_map.__module__ == __name__:
+        # 0.4.x check_rep has no replication rule for ad_checkpoint's `name`
+        # primitive (checkpoint_name in the towers' remat policies), so any
+        # checked shard_map over a tower block raises NotImplementedError.
+        # `name` is rep-transparent — the standard identity check is exact.
+        try:
+            from jax._src.ad_checkpoint import name_p
+            from jax.experimental import shard_map as _sm_mod
+
+            if name_p not in _sm_mod._check_rules:
+                _sm_mod.register_standard_check(name_p)
+                _sm_mod.register_norewrite(name_p)
+        except Exception:  # pragma: no cover - registry internals moved
+            pass
+
+    if not hasattr(jax.distributed, "is_initialized"):
+
+        def is_initialized():
+            from jax._src import distributed as _dist
+
+            return _dist.global_state.client is not None
+
+        jax.distributed.is_initialized = is_initialized
+
+
+install()

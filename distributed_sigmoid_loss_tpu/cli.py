@@ -2,7 +2,7 @@
 
 The reference has no CLI (its entry points are test-file ``__main__`` blocks,
 /root/reference/test_distributed_sigmoid_loss.py:144-148); a framework needs one.
-Four subcommands tie the subsystems together:
+The subcommands tie the subsystems together:
 
 - ``train`` — end-to-end SigLIP training on synthetic data: mesh, towers,
   distributed sigmoid loss (all-gather or ring), optax, metrics logging,
@@ -14,6 +14,11 @@ Four subcommands tie the subsystems together:
   topology.
 - ``bench`` — the headline throughput benchmark (delegates to bench.py when run
   from a repo checkout; the measured JSON contract is documented there).
+- ``serve-bench`` — online-serving micro-bench: concurrent client threads
+  through the batched/cached/bucketed ``serve/`` stack (engine + micro-batcher
+  + LRU cache + retrieval index) on synthetic data; prints the ``stats()``
+  snapshot (qps, latency percentiles, batch histogram, cache hit rate, compile
+  count) as one JSON record. CPU-runnable — docs/SERVING.md.
 
 ``train`` and ``eval`` accept ``--cpu-devices N`` to emulate an N-chip mesh on
 CPU — the TPU-native analogue of the reference's ``mp.spawn`` + Gloo localhost
@@ -1219,6 +1224,158 @@ def cmd_bench(extra: list[str]) -> int:
     os.execv(sys.executable, [sys.executable, bench] + extra)
 
 
+def cmd_serve_bench(args) -> int:
+    """Drive the serve/ stack on synthetic data with concurrent clients and
+    print the ``stats()`` snapshot as one JSON record (bench.py style).
+
+    The operational proof of the serving layer: with warmed buckets the
+    printed ``compile_count`` equals ``bucket_space`` (the number of shape
+    buckets) — NOT the request count — while concurrent clients coalesce into
+    batched engine calls (see ``batch_size_hist``) and repeated content hits
+    the cache (``cache.hit_rate``).
+    """
+    _bootstrap_devices(args)
+    import concurrent.futures
+    import json
+    import time
+
+    import numpy as np
+
+    from distributed_sigmoid_loss_tpu.data import SyntheticImageText
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.serve import (
+        EmbeddingCache,
+        EmbeddingService,
+        InferenceEngine,
+        QueueFullError,
+        RequestTimeoutError,
+        RetrievalIndex,
+    )
+    from distributed_sigmoid_loss_tpu.utils.logging import MetricsLogger
+
+    if args.requests < 1 or args.clients < 1:
+        print("--requests and --clients must be >= 1", file=sys.stderr)
+        return 2
+    try:
+        buckets = tuple(int(b) for b in args.batch_buckets.split(","))
+    except ValueError:
+        print(f"--batch-buckets must be comma-separated ints, got "
+              f"{args.batch_buckets!r}", file=sys.stderr)
+        return 2
+
+    import jax
+    from flax import linen as nn
+
+    cfg = _model_config(args)
+    model = SigLIP(cfg)
+    mesh = None
+    if args.mesh:
+        from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        n_dev = len(jax.devices())
+        if any(b % n_dev for b in buckets):
+            print(
+                f"--mesh: batch buckets {buckets} must all divide the device "
+                f"count {n_dev} (every device holds whole rows)",
+                file=sys.stderr,
+            )
+            return 2
+
+    pool = max(args.pool, 1)
+    source = iter(SyntheticImageText(cfg, pool, image_seed=args.seed + 1,
+                                     text_seed=args.seed + 2))
+    batch = next(source)
+    pool_tokens = np.asarray(batch["tokens"])
+    pool_images = np.asarray(batch["images"])
+
+    params = nn.meta.unbox(
+        model.init(jax.random.key(args.seed), pool_images[:1],
+                   pool_tokens[:1])["params"]
+    )
+    engine = InferenceEngine.from_model(
+        model, params, batch_buckets=buckets, mesh=mesh
+    )
+    t0 = time.perf_counter()
+    warmed = engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    print(
+        f"warmed {warmed} shape buckets in {warmup_s:.1f}s "
+        f"({args.model} model, {len(buckets)} batch buckets)",
+        file=sys.stderr,
+    )
+
+    # Corpus embeddings straight through the engine (the service clock should
+    # measure client traffic, not index build); chunked to the largest bucket.
+    index = RetrievalIndex()
+    step = buckets[-1]
+    for i in range(0, min(args.index_size, pool), step):
+        index.add(engine.encode_image(pool_images[i : i + step]))
+
+    service = EmbeddingService(
+        engine,
+        cache=EmbeddingCache(args.cache_size),
+        index=index,
+        max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue,
+        default_timeout=60.0,
+        logger=MetricsLogger(),
+    )
+
+    def client(cid: int, n_ops: int) -> None:
+        rng = np.random.default_rng(args.seed * 1000 + cid)
+        for _ in range(n_ops):
+            op = rng.random()
+            try:
+                if op < 0.2:  # image encode from the shared pool (cacheable)
+                    service.encode_image(pool_images[rng.integers(pool)])
+                elif op < 0.4:  # retrieval query
+                    service.search(pool_tokens[rng.integers(pool)], k=args.topk)
+                elif op < 0.7:  # repeated text from the pool (cacheable)
+                    service.encode_text(pool_tokens[rng.integers(pool)])
+                else:  # fresh text (guaranteed cache miss → batcher/engine)
+                    row = rng.integers(
+                        0, cfg.text.vocab_size,
+                        cfg.text.context_length, dtype=np.int32,
+                    )
+                    service.encode_text(row)
+            except (QueueFullError, RequestTimeoutError):
+                pass  # shed/missed requests are counted in service.stats()
+
+    per_client = [args.requests // args.clients] * args.clients
+    for i in range(args.requests % args.clients):
+        per_client[i] += 1
+    with concurrent.futures.ThreadPoolExecutor(args.clients) as pool_ex:
+        list(pool_ex.map(client, range(args.clients), per_client))
+
+    snap = service.stats()
+    service.close()
+    record = {
+        "metric": "serve_bench",
+        "value": snap["qps"],
+        "unit": "req/s",
+        "model": args.model,
+        "clients": args.clients,
+        "requests_sent": args.requests,
+        "batch_buckets": list(buckets),
+        "max_wait_ms": args.max_wait_ms,
+        "sharded": bool(mesh),
+        "warmup_s": round(warmup_s, 2),
+        **snap,
+    }
+    print(json.dumps(record))
+    # Steady-state contract: every compile happened at warmup — one per shape
+    # bucket. A violation means a request escaped the bucket grid.
+    if snap["compile_count"] != warmed:
+        print(
+            f"WARNING: compile_count {snap['compile_count']} != warmed "
+            f"buckets {warmed} — a request triggered a fresh compile",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def cmd_tokenizer(args) -> int:
     """Train a BPE vocab from captions and write it as json."""
     import glob as globmod
@@ -1501,6 +1658,44 @@ def main(argv=None) -> int:
     )
     bn.add_argument("rest", nargs=argparse.REMAINDER)
 
+    sb = sub.add_parser(
+        "serve-bench",
+        help="online serving micro-bench: concurrent clients through the "
+             "batched/cached/bucketed serve/ stack; prints the stats "
+             "snapshot as JSON (CPU-runnable)",
+    )
+    sb.add_argument("--requests", type=int, default=512,
+                    help="total client requests across all clients")
+    sb.add_argument("--clients", type=int, default=8,
+                    help="concurrent client threads")
+    sb.add_argument("--model", choices=["b16", "l14", "so400m", "tiny"],
+                    default="tiny",
+                    help="tower config (default tiny: the CPU-runnable "
+                         "smoke/bench shape; big models need a real chip)")
+    sb.add_argument("--batch-buckets", default="1,8,32", metavar="N,N,...",
+                    help="padded batch-size buckets the engine compiles "
+                         "(steady state never compiles outside the grid)")
+    sb.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="micro-batcher deadline: max ms a queued request "
+                         "waits for coalescing before a partial flush")
+    sb.add_argument("--max-queue", type=int, default=1024,
+                    help="bounded request queue per modality (full queue "
+                         "rejects with backpressure)")
+    sb.add_argument("--cache-size", type=int, default=4096,
+                    help="LRU embedding cache capacity (entries)")
+    sb.add_argument("--pool", type=int, default=64,
+                    help="distinct synthetic items clients draw from "
+                         "(repeats exercise the cache)")
+    sb.add_argument("--index-size", type=int, default=64,
+                    help="corpus rows indexed for the search requests")
+    sb.add_argument("--topk", type=int, default=5)
+    sb.add_argument("--seed", type=int, default=0)
+    sb.add_argument("--mesh", action="store_true",
+                    help="shard engine batches over the dp mesh (batch "
+                         "buckets must divide the device count)")
+    sb.add_argument("--cpu-devices", type=int, default=0,
+                    help="emulate N CPU devices (pair with --mesh)")
+
     argv = sys.argv[1:] if argv is None else list(argv)
     # bench forwards its arguments to bench.py untouched; argparse REMAINDER
     # cannot capture a LEADING option (`bench --use-pallas` errors), so bench is
@@ -1515,6 +1710,7 @@ def main(argv=None) -> int:
         "export": cmd_export,
         "tokenizer": cmd_tokenizer,
         "bench": lambda a: cmd_bench(a.rest),
+        "serve-bench": cmd_serve_bench,
     }
     return dispatch[args.cmd](args)
 

@@ -16,12 +16,29 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
 
-__all__ = ["retrieval_ranks", "recall_at_k", "retrieval_metrics"]
+__all__ = ["retrieval_ranks", "recall_at_k", "retrieval_metrics", "topk_ids"]
+
+
+def topk_ids(sims, k: int) -> np.ndarray:
+    """Deterministic exact top-k ids over the last axis: descending score,
+    ties broken toward the LOWER id.
+
+    THE shared ranking contract between offline eval and online serving:
+    ``serve.index.RetrievalIndex.search`` must reproduce this ordering exactly
+    (tested on shared fixtures), and on a tie-free similarity row the position
+    of item ``i`` here equals ``retrieval_ranks``'s strictly-greater count.
+    Host-side numpy on purpose — the stable sort that pins the tie order has
+    no jnp equivalent, and ranking runs on materialized scores anyway.
+    """
+    sims = np.asarray(sims)
+    order = np.argsort(-sims, axis=-1, kind="stable")
+    return order[..., :k]
 
 
 def retrieval_ranks(zimg: jax.Array, ztxt: jax.Array) -> jax.Array:

@@ -38,6 +38,8 @@ __all__ = [
     "short_attention_vmem_bytes",
     "short_attention_bwd_batched_fits",
     "set_bwd_batch_heads",
+    "traced_bwd_batch_heads",
+    "reset_traced_bwd_batch_heads",
     "SHORT_ATTENTION_MAX_SEQ",
 ]
 
@@ -47,13 +49,37 @@ __all__ = [
 # time — set it before building/jitting the step.
 _DEFAULT_BATCH_HEADS = False
 
+# Every backward-kernel choice RESOLVED at trace time in this process. The
+# default above is mutable global state, so a step traced before
+# set_bwd_batch_heads silently keeps the other kernel while argv claims the
+# A/B ran (advisor, round 5) — records must cross-check against what actually
+# traced, not what was requested (bench.py does, before emitting).
+_TRACED_BWD_BATCH_HEADS: set[bool] = set()
+
 
 def set_bwd_batch_heads(enabled: bool) -> None:
     """Set the process default for ``batch_heads=None`` call sites (the
     towers). Call BEFORE tracing: compiled programs keep the kernel they were
-    traced with."""
+    traced with — :func:`traced_bwd_batch_heads` reports what actually did."""
     global _DEFAULT_BATCH_HEADS
     _DEFAULT_BATCH_HEADS = bool(enabled)
+
+
+def traced_bwd_batch_heads() -> tuple[bool, ...]:
+    """Distinct backward-kernel choices resolved at trace time so far, sorted.
+
+    ``()`` = no fused short-attention backward has been traced in this
+    process; ``(False,)`` / ``(True,)`` = every trace used the per-head loop /
+    the head-batched kernel; ``(False, True)`` = mixed (some step traced
+    before a ``set_bwd_batch_heads`` flip — the exact record-corruption case
+    the cross-check exists to catch).
+    """
+    return tuple(sorted(_TRACED_BWD_BATCH_HEADS))
+
+
+def reset_traced_bwd_batch_heads() -> None:
+    """Clear the trace record (test isolation)."""
+    _TRACED_BWD_BATCH_HEADS.clear()
 
 _NEG_INF = -1e30
 
@@ -257,6 +283,9 @@ def _short_attention_bwd(causal, scale, interpret, batch_heads, residuals, g):
     spec = _specs(b, s, h * dh, 4)
     if batch_heads is None:
         batch_heads = _DEFAULT_BATCH_HEADS
+    # This body runs at TRACE time: what lands in the set is the kernel the
+    # compiled program will actually execute, not what argv asked for.
+    _TRACED_BWD_BATCH_HEADS.add(bool(batch_heads))
     if batch_heads and not short_attention_bwd_batched_fits(
         s, h * dh, h, q.dtype.itemsize
     ):

@@ -1,0 +1,50 @@
+"""serve — online embedding & retrieval serving over the trained two-tower model.
+
+The layer that turns an exported/trained SigLIP into a request-serving
+system (the ROADMAP's "heavy traffic" north star), runnable on CPU in tests:
+
+- :mod:`.engine` — jitted encoders behind fixed padded shape buckets, so
+  steady-state traffic never triggers a fresh XLA compile (compile-count
+  introspection built in; optional dp-mesh sharded execution).
+- :mod:`.batcher` — thread-safe micro-batcher: coalesces concurrent callers
+  into one engine call under a ``max_wait_ms`` deadline, with bounded-queue
+  backpressure (typed rejection, not unbounded growth).
+- :mod:`.cache` — content-hash-keyed LRU embedding cache with
+  hit/miss/eviction counters.
+- :mod:`.index` — exact chunked dot-product top-k over L2-normalized rows,
+  ranking-identical to ``eval.retrieval`` (shared tie-break contract).
+- :mod:`.service` — the façade: ``encode_text`` / ``encode_image`` /
+  ``search`` with per-request timeouts and a ``stats()`` snapshot (qps,
+  latency percentiles, batch histogram, cache hit rate, compile count).
+
+Entry point: ``python -m distributed_sigmoid_loss_tpu serve-bench`` drives the
+whole stack on synthetic data and prints the stats snapshot as JSON.
+"""
+
+from distributed_sigmoid_loss_tpu.serve.batcher import (  # noqa: F401
+    BatcherClosedError,
+    MicroBatcher,
+    QueueFullError,
+)
+from distributed_sigmoid_loss_tpu.serve.cache import (  # noqa: F401
+    EmbeddingCache,
+    content_key,
+)
+from distributed_sigmoid_loss_tpu.serve.engine import InferenceEngine  # noqa: F401
+from distributed_sigmoid_loss_tpu.serve.index import RetrievalIndex  # noqa: F401
+from distributed_sigmoid_loss_tpu.serve.service import (  # noqa: F401
+    EmbeddingService,
+    RequestTimeoutError,
+)
+
+__all__ = [
+    "BatcherClosedError",
+    "EmbeddingCache",
+    "EmbeddingService",
+    "InferenceEngine",
+    "MicroBatcher",
+    "QueueFullError",
+    "RequestTimeoutError",
+    "RetrievalIndex",
+    "content_key",
+]

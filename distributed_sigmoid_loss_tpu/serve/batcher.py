@@ -1,0 +1,178 @@
+"""Thread-safe dynamic micro-batcher: coalesce concurrent requests into one
+engine call.
+
+Online traffic arrives one request at a time, but the engine's throughput
+comes from batched MXU matmuls — the classic serving trade (batch for
+throughput, deadline for latency). This batcher is the piece in between: a
+bounded queue of single-item requests, a worker that drains it into batches of
+at most ``max_batch_size``, waiting at most ``max_wait_ms`` past the FIRST
+queued item's arrival before flushing a partial batch, and futures fanning the
+results back to the callers.
+
+Backpressure is explicit: when the queue is full, ``submit`` raises
+:class:`QueueFullError` immediately instead of growing without bound — the
+caller (or its load balancer) sheds the request while the tail latency of
+queued work stays bounded by ``max_queue / throughput``.
+
+The batch function runs on the worker thread only, one call at a time, so a
+non-thread-safe engine path is safe behind a batcher.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import Counter
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+__all__ = ["MicroBatcher", "QueueFullError", "BatcherClosedError"]
+
+
+class QueueFullError(RuntimeError):
+    """The batcher's bounded queue is full — request rejected (backpressure)."""
+
+
+class BatcherClosedError(RuntimeError):
+    """submit() after close(): the worker is draining/stopped."""
+
+
+@dataclass
+class _Request:
+    item: Any
+    future: Future = field(default_factory=Future)
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+_SENTINEL = object()
+
+
+class MicroBatcher:
+    """Coalesce single-item submissions into batched ``run_batch`` calls.
+
+    ``run_batch(items) -> results`` receives a list of 1..max_batch_size items
+    and must return one result per item, in order. A raised exception fails
+    every future of that batch (callers see the error; the worker keeps
+    serving subsequent batches).
+    """
+
+    def __init__(
+        self,
+        run_batch: Callable[[list], Sequence],
+        *,
+        max_batch_size: int = 32,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 1024,
+        name: str = "batcher",
+    ):
+        if max_batch_size < 1:
+            raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        self._run_batch = run_batch
+        self.max_batch_size = max_batch_size
+        self.max_wait = max_wait_ms / 1000.0
+        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._closed = False
+        self._hist_lock = threading.Lock()
+        self._batch_sizes: Counter[int] = Counter()
+        self._worker = threading.Thread(
+            target=self._loop, name=f"{name}-worker", daemon=True
+        )
+        self._worker.start()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, item) -> Future:
+        """Enqueue one item; returns the Future of its result.
+
+        Raises :class:`QueueFullError` when the bounded queue is full and
+        :class:`BatcherClosedError` after :meth:`close`.
+        """
+        if self._closed:
+            raise BatcherClosedError("submit() on a closed MicroBatcher")
+        req = _Request(item)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            raise QueueFullError(
+                f"batcher queue full ({self._queue.maxsize} pending); "
+                "retry later or raise max_queue"
+            ) from None
+        return req.future
+
+    def close(self, *, wait: bool = True) -> None:
+        """Stop accepting work; the worker drains what is already queued."""
+        if self._closed:
+            return
+        self._closed = True
+        # The sentinel is the wake-up/stop signal; put() (blocking) because a
+        # full queue still needs the worker stopped after it drains.
+        self._queue.put(_SENTINEL)
+        if wait:
+            self._worker.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def batch_size_histogram(self) -> dict[int, int]:
+        """{batch_size: count of engine calls at that size}."""
+        with self._hist_lock:
+            return dict(sorted(self._batch_sizes.items()))
+
+    # -- worker side ---------------------------------------------------------
+
+    def _collect(self) -> list[_Request] | None:
+        """Block for the first request, then fill the batch until size or the
+        first request's deadline. None = sentinel seen with nothing pending."""
+        first = self._queue.get()
+        if first is _SENTINEL:
+            return None
+        batch = [first]
+        deadline = first.enqueued_at + self.max_wait
+        while len(batch) < self.max_batch_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt is _SENTINEL:
+                # Re-queue so the outer loop terminates after this batch.
+                self._queue.put(_SENTINEL)
+                break
+            batch.append(nxt)
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            with self._hist_lock:
+                self._batch_sizes[len(batch)] += 1
+            try:
+                results = self._run_batch([r.item for r in batch])
+            except Exception as e:  # noqa: BLE001 — fan the failure out
+                for r in batch:
+                    if not r.future.cancelled():
+                        r.future.set_exception(e)
+                continue
+            if len(results) != len(batch):
+                err = RuntimeError(
+                    f"run_batch returned {len(results)} results for "
+                    f"{len(batch)} items"
+                )
+                for r in batch:
+                    if not r.future.cancelled():
+                        r.future.set_exception(err)
+                continue
+            for r, res in zip(batch, results):
+                if not r.future.cancelled():
+                    r.future.set_result(res)

@@ -1,0 +1,196 @@
+"""Jitted inference engine with fixed padded shape buckets — recompile-free
+steady-state serving.
+
+XLA compiles one program per input SHAPE. Online traffic has arbitrary batch
+sizes and text lengths, so feeding raw request shapes to a jitted encoder
+means a fresh multi-second compile whenever a new size first appears — the
+classic serving latency cliff. The engine applies the same shape discipline
+the training stack uses (static per-bucket shapes, one compiled program each):
+every call is padded UP to a fixed (batch_bucket, len_bucket) grid point, run
+through the jitted tower, and sliced back down. After :meth:`warmup` the
+compile count is exactly ``bucket_space`` — the number of grid points — and
+never grows again, no matter how many requests arrive.
+
+Rows are independent through both towers (attention mixes within a row only),
+so batch padding never perturbs real rows. Text LENGTH padding uses token id 0
+up to the bucket — identical to the training tokenizer's padding to
+``context_length`` — so the default single len-bucket (= context_length)
+reproduces training-time embeddings bit-for-bit; extra shorter buckets are an
+opt-in latency/recall trade for models trained with length buckets.
+
+Optionally shards the padded batch over an existing ``parallel.mesh`` mesh
+(``mesh=``): the batch axis is placed on ``dp`` and XLA partitions the tower
+forward — the same data-parallel layout eval uses. Bucket sizes must then
+divide the dp axis so every device holds whole rows.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
+
+__all__ = ["InferenceEngine"]
+
+
+def _validated_buckets(buckets: Sequence[int], what: str) -> tuple[int, ...]:
+    out = tuple(sorted(set(int(b) for b in buckets)))
+    if not out or out[0] < 1:
+        raise ValueError(f"{what} must be positive, got {buckets!r}")
+    return out
+
+
+class InferenceEngine:
+    """Bucketed, jitted two-tower encoder: ``encode_image`` / ``encode_text``.
+
+    ``encode_image_fn(params, images)`` / ``encode_text_fn(params, tokens)``
+    are pure functions returning L2-normalized embedding rows (the model's
+    ``SigLIP.encode_image`` / ``encode_text`` methods, or a loaded exported
+    forward — anything traceable). They are jitted here, once each; bucket
+    shapes do the rest of the compile hygiene.
+    """
+
+    def __init__(
+        self,
+        encode_image_fn: Callable,
+        encode_text_fn: Callable,
+        params: Any,
+        *,
+        batch_buckets: Sequence[int] = (1, 8, 32, 128),
+        text_len_buckets: Sequence[int] = (64,),
+        image_shape: tuple[int, int, int] = (224, 224, 3),
+        token_dtype=np.int32,
+        mesh=None,
+        batch_axis: str = data_axis,
+    ):
+        self.batch_buckets = _validated_buckets(batch_buckets, "batch_buckets")
+        self.text_len_buckets = _validated_buckets(
+            text_len_buckets, "text_len_buckets"
+        )
+        self.image_shape = tuple(image_shape)
+        self.token_dtype = np.dtype(token_dtype)
+        self.params = params
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        if mesh is not None:
+            dp = mesh.shape[batch_axis]
+            bad = [b for b in self.batch_buckets if b % dp]
+            if bad:
+                raise ValueError(
+                    f"batch buckets {bad} do not divide the mesh's "
+                    f"{batch_axis}={dp} axis; every device must hold whole rows"
+                )
+        self._jit = {
+            "image": jax.jit(encode_image_fn),
+            "text": jax.jit(encode_text_fn),
+        }
+        self._compiled: set[tuple] = set()
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_model(cls, model, params, **kw):
+        """Engine over a live ``models.SigLIP`` — buckets default from its
+        config (text len bucket = context_length: training-identical padding)."""
+        cfg = model.cfg
+        kw.setdefault("text_len_buckets", (cfg.text.context_length,))
+        kw.setdefault(
+            "image_shape", (cfg.vision.image_size, cfg.vision.image_size, 3)
+        )
+
+        def img_fn(p, images):
+            return model.apply({"params": p}, images, method=type(model).encode_image)
+
+        def txt_fn(p, tokens):
+            return model.apply({"params": p}, tokens, method=type(model).encode_text)
+
+        return cls(img_fn, txt_fn, params, **kw)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct (kind, padded shape) programs built so far. Steady state:
+        equal to the warmed bucket count, NEVER the request count."""
+        with self._lock:
+            return len(self._compiled)
+
+    @property
+    def bucket_space(self) -> int:
+        """Total grid points: image batch buckets + text (batch × len) buckets."""
+        return len(self.batch_buckets) * (1 + len(self.text_len_buckets))
+
+    def jit_cache_size(self) -> int | None:
+        """The jit layer's own entry count (cross-check for tests); None when
+        the running jax build doesn't expose it."""
+        sizes = []
+        for fn in self._jit.values():
+            if hasattr(fn, "_cache_size"):
+                sizes.append(fn._cache_size())
+        return sum(sizes) if sizes else None
+
+    # -- encode paths --------------------------------------------------------
+
+    def _bucket_for(self, n: int, buckets: tuple[int, ...], what: str) -> int:
+        for b in buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"{what} {n} exceeds the largest bucket {buckets[-1]}; "
+            "split the request or extend the bucket grid"
+        )
+
+    def _run(self, kind: str, padded: np.ndarray) -> np.ndarray:
+        if self.mesh is not None:
+            spec = P(self.batch_axis, *([None] * (padded.ndim - 1)))
+            padded = jax.device_put(padded, NamedSharding(self.mesh, spec))
+        key = (kind, padded.shape)
+        with self._lock:
+            self._compiled.add(key)
+        return np.asarray(self._jit[kind](self.params, padded))
+
+    def encode_text(self, tokens) -> np.ndarray:
+        """(n, s) or (s,) int token ids → (n, embed_dim) float32 rows.
+
+        Pads n up to a batch bucket and s up to a len bucket (id 0 — the
+        training pad token), then slices the real rows back out.
+        """
+        arr = np.asarray(tokens, dtype=self.token_dtype)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        n, s = arr.shape
+        nb = self._bucket_for(n, self.batch_buckets, "batch size")
+        sb = self._bucket_for(s, self.text_len_buckets, "text length")
+        padded = np.zeros((nb, sb), dtype=self.token_dtype)
+        padded[:n, :s] = arr
+        return self._run("text", padded)[:n]
+
+    def encode_image(self, images) -> np.ndarray:
+        """(n, h, w, 3) or (h, w, 3) float pixels → (n, embed_dim) rows."""
+        arr = np.asarray(images, dtype=np.float32)
+        if arr.ndim == 3:
+            arr = arr[None]
+        if arr.shape[1:] != self.image_shape:
+            raise ValueError(
+                f"image shape {arr.shape[1:]} != engine's {self.image_shape}; "
+                "resize upstream (the compiled towers are shape-fixed)"
+            )
+        n = arr.shape[0]
+        nb = self._bucket_for(n, self.batch_buckets, "batch size")
+        padded = np.zeros((nb, *self.image_shape), dtype=np.float32)
+        padded[:n] = arr
+        return self._run("image", padded)[:n]
+
+    def warmup(self) -> int:
+        """Compile every bucket combination up front (zeros input) so the
+        first real request never pays a compile. Returns the compile count —
+        after this, equal to :attr:`bucket_space` and constant."""
+        for nb in self.batch_buckets:
+            self.encode_image(np.zeros((nb, *self.image_shape), np.float32))
+            for sb in self.text_len_buckets:
+                self.encode_text(np.zeros((nb, sb), self.token_dtype))
+        return self.compile_count

@@ -22,6 +22,7 @@ from distributed_sigmoid_loss_tpu.train.resilience import (  # noqa: F401
 from distributed_sigmoid_loss_tpu.train.export import (  # noqa: F401
     export_step,
     load_exported,
+    load_forward,
     save_exported,
 )
 from distributed_sigmoid_loss_tpu.train.ema import (  # noqa: F401

@@ -41,6 +41,7 @@ __all__ = [
     "ExportedStep",
     "export_step",
     "load_exported",
+    "load_forward",
     "save_exported",
 ]
 
@@ -144,3 +145,28 @@ def load_exported(path: str) -> jax.export.Exported:
     with open(path, "rb") as f:
         data = f.read()
     return jax.export.deserialize(bytearray(data))
+
+
+def load_forward(path: str) -> Callable:
+    """Load a ``--what forward`` artifact as a structured inference callable.
+
+    The serving load-side helper: the artifact's flat calling convention is
+    re-wrapped so the consumer calls ``fn(params, images, tokens)`` with the
+    params PYTREE (flattened here — leaf order is the tree-canonical order the
+    export used) and gets ``(zimg, ztxt)`` back. The returned fn is traceable,
+    so ``serve.engine.InferenceEngine`` can jit it like a live model — with
+    one caveat the engine's buckets must respect: the artifact was lowered at
+    ONE batch shape, so it serves exactly that bucket.
+    """
+    loaded = load_exported(path)
+
+    def fn(params, images, tokens):
+        out = loaded.call(*jax.tree.leaves((params, images, tokens)))
+        if len(out) != 2:
+            raise ValueError(
+                f"artifact at {path!r} returned {len(out)} leaves, expected "
+                "(zimg, ztxt) — was it exported with `--what forward`?"
+            )
+        return tuple(out)
+
+    return fn

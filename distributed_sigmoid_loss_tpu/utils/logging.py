@@ -1,15 +1,51 @@
 """Minimal metrics logging for the train loop (SURVEY.md §5: the reference has only
 commented-out grad prints; the plan is scalar loss/t/bias + pairs/sec logging while
-keeping the loss function pure)."""
+keeping the loss function pure) — plus the latency-window aggregation the serving
+stack's ``stats()`` snapshots are built on."""
 
 from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
+from collections import deque
 from typing import IO, Mapping
 
-__all__ = ["MetricsLogger"]
+__all__ = ["MetricsLogger", "LatencyWindow"]
+
+
+class LatencyWindow:
+    """Rolling window of request durations → p50/p95 percentiles.
+
+    Bounded (``maxlen`` most recent samples) so a long-lived service never
+    grows its metrics state; thread-safe because producers are the serving
+    stack's client threads. Percentiles use the nearest-rank method on the
+    retained window — an honest tail estimate without per-request history.
+    """
+
+    def __init__(self, maxlen: int = 8192):
+        self._samples: deque[float] = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.count = 0  # total ever recorded (not just retained)
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(float(seconds))
+            self.count += 1
+
+    def percentiles_ms(self, ps: tuple[int, ...] = (50, 95)) -> dict[str, float]:
+        """{"p50_ms": ..., "p95_ms": ...} over the retained window (zeros when
+        nothing has been recorded yet — a snapshot must never raise)."""
+        with self._lock:
+            samples = sorted(self._samples)
+        if not samples:
+            return {f"p{p}_ms": 0.0 for p in ps}
+        out = {}
+        for p in ps:
+            idx = min(len(samples) - 1, max(0, int(len(samples) * p / 100.0)))
+            out[f"p{p}_ms"] = round(samples[idx] * 1000.0, 3)
+        return out
 
 
 class MetricsLogger:
@@ -43,4 +79,12 @@ class MetricsLogger:
                 )
             self._last_time, self._last_step = now, step
         self.stream.write(json.dumps(record) + "\n")
+        self.stream.flush()
+
+    def write(self, record: Mapping) -> None:
+        """Emit a raw JSON-lines record with no step bookkeeping — for
+        structured snapshots (the serving stack's ``stats()``: nested cache /
+        histogram dicts) that the scalar ``log`` contract can't carry. The
+        steps/sec clock is untouched, same as ``force=True``."""
+        self.stream.write(json.dumps(dict(record)) + "\n")
         self.stream.flush()

@@ -1,0 +1,54 @@
+"""serve/ demo: tiny towers behind the full serving stack, on CPU.
+
+Build an engine with fixed shape buckets, warm it, wrap it in the service
+(cache + micro-batcher + index), serve a few requests — including cache hits
+and a top-k search — and print the stats snapshot. docs/SERVING.md explains
+every knob; `python -m distributed_sigmoid_loss_tpu serve-bench` is the
+load-generating version of this script.
+"""
+
+import jax
+import numpy as np
+from flax import linen as nn
+
+from distributed_sigmoid_loss_tpu.models import SigLIP
+from distributed_sigmoid_loss_tpu.serve import (
+    EmbeddingCache,
+    EmbeddingService,
+    InferenceEngine,
+)
+from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+from distributed_sigmoid_loss_tpu.utils.logging import MetricsLogger
+
+
+def main():
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((8, 16, 16, 3)).astype(np.float32)
+    tokens = rng.integers(0, 64, (8, 8), dtype=np.int32)
+    params = nn.meta.unbox(
+        model.init(jax.random.key(0), images[:1], tokens[:1])["params"]
+    )
+
+    engine = InferenceEngine.from_model(model, params, batch_buckets=(1, 4, 8))
+    print(f"warming {engine.bucket_space} shape buckets...")
+    engine.warmup()  # steady state never compiles again
+
+    with EmbeddingService(
+        engine, cache=EmbeddingCache(256), max_wait_ms=5.0,
+        logger=MetricsLogger(),
+    ) as service:
+        # Index a small corpus of image embeddings, then search it with text.
+        service.index.add(service.encode_image(images))
+        scores, ids = service.search(tokens[3], k=3)
+        print(f"top-3 for text 3: ids={ids[0].tolist()} "
+              f"scores={[round(float(s), 3) for s in scores[0]]}")
+
+        service.encode_text(tokens)  # first pass: misses
+        service.encode_text(tokens)  # second pass: all cache hits
+        service.log_stats()  # JSON snapshot via MetricsLogger
+
+
+if __name__ == "__main__":
+    main()

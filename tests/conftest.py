@@ -53,6 +53,7 @@ _STANDARD_MODULES = {
     "test_core_loss",
     "test_distributed_parity",
     "test_pipeline",
+    "test_serve",
     "test_torch_reference_parity",
 }
 

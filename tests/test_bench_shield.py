@@ -95,6 +95,139 @@ def test_sigterm_mid_compile_defers_and_leaves_child_running():
             os.unlink(stdout_path)
 
 
+def _bench_module():
+    """Import bench.py as a module (repo root is on sys.path via conftest);
+    its top-level imports are stdlib-only, so this never initializes jax."""
+    import bench
+
+    return bench
+
+
+def _bench_args(**overrides):
+    """A Namespace with the exact flag surface _fresh_compile_config reads,
+    at headline-run defaults."""
+    import argparse
+
+    defaults = dict(
+        step_breakdown=False, moe_breakdown=False, moe=0, context=0,
+        attn_impl="auto", text_attn_impl="", attn_bwd="loop",
+        accum_negatives="local", gradcache_bf16=False,
+    )
+    defaults.update(overrides)
+    return argparse.Namespace(**defaults)
+
+
+def test_fresh_compile_config_covers_gradcache_variants():
+    """Advisor (round 5): the bf16 GradCache stash — by definition not in the
+    warm cache — must run under the shield, as must any exact-negatives
+    accumulation config (a different program than the headline step)."""
+    bench = _bench_module()
+    assert not bench._fresh_compile_config(_bench_args())
+    assert bench._fresh_compile_config(_bench_args(gradcache_bf16=True))
+    assert bench._fresh_compile_config(_bench_args(accum_negatives="global"))
+    # The pre-existing triggers still hold.
+    assert bench._fresh_compile_config(_bench_args(attn_impl="dense"))
+    assert bench._fresh_compile_config(_bench_args(attn_bwd="batched"))
+
+
+class _FakeChild:
+    def __init__(self, rc, pid=12345):
+        self._rc, self.pid = rc, pid
+
+    def poll(self):
+        return self._rc
+
+
+def _signal_record_lines(tmp_path, capsys, rc, child_stdout_text):
+    """Drive _shield_signal_record with a fake child and captured stdout."""
+    bench = _bench_module()
+    args = _bench_args(
+        eval_throughput=False, model="tiny", batch=4, steps=2,
+        metric_suffix="",
+    )
+    out = open(tmp_path / "child.out", "w+")
+    errf = open(tmp_path / "child.err", "w+")
+    out.write(child_stdout_text)
+    out.flush()
+    metric, unit = bench._metric_for_mode(args)
+    bench._shield_signal_record(
+        args, _FakeChild(rc), out, errf, metric, unit, signal.SIGTERM
+    )
+    out.close()
+    errf.close()
+    return [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+
+
+def test_signal_after_child_exit_relays_record_not_deferral(tmp_path, capsys):
+    """Advisor (round 5): a signal landing once the child has terminated must
+    emit the child's own record (the normal path), never a 'left running'
+    deferral naming a dead pid."""
+    child_rec = json.dumps({"metric": "m", "value": 1.5})
+    recs = _signal_record_lines(tmp_path, capsys, rc=0,
+                                child_stdout_text=child_rec + "\n")
+    assert recs == [{"metric": "m", "value": 1.5}]
+
+
+def test_signal_after_child_exit_without_record_reports_exit(tmp_path, capsys):
+    recs = _signal_record_lines(tmp_path, capsys, rc=3, child_stdout_text="")
+    (rec,) = recs
+    assert "deferred" not in rec
+    assert rec["value"] == 0.0
+    assert "already exited rc=3" in rec["error"]
+
+
+def test_signal_with_live_child_still_defers(tmp_path, capsys):
+    bench = _bench_module()
+    args = _bench_args(eval_throughput=False, model="tiny", batch=4, steps=2,
+                       metric_suffix="")
+    out = open(tmp_path / "c.out", "w+")
+    errf = open(tmp_path / "c.err", "w+")
+    metric, unit = bench._metric_for_mode(args)
+    bench._shield_signal_record(
+        args, _FakeChild(None), out, errf, metric, unit, signal.SIGTERM
+    )
+    out.close()
+    errf.close()
+    (rec,) = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rec["deferred"] is True
+    assert rec["child_pid"] == 12345
+
+
+def test_attn_bwd_record_uses_traced_choice_not_argv():
+    """Advisor (round 5): records must carry the backward kernel that actually
+    TRACED; argv disagreements get flagged instead of silently logged."""
+    bench = _bench_module()
+    from distributed_sigmoid_loss_tpu.ops import pallas_short_attention as psa
+
+    psa.reset_traced_bwd_batch_heads()
+    try:
+        # Requested batched but nothing ever traced → flagged, never a clean tag.
+        f = bench._attn_bwd_record_fields(_bench_args(attn_bwd="batched"))
+        assert f["attn_bwd_mismatch"] is True
+        assert f["attn_bwd_traced"] == "none"
+
+        # Step traced BEFORE the set_bwd_batch_heads flip: per-head loop ran.
+        psa._TRACED_BWD_BATCH_HEADS.add(False)
+        f = bench._attn_bwd_record_fields(_bench_args(attn_bwd="batched"))
+        assert f["attn_bwd"] == "loop"  # the truth, not argv
+        assert f["attn_bwd_argv"] == "batched"
+        assert f["attn_bwd_mismatch"] is True
+
+        # Consistent run: traced choice matches argv, clean tag only.
+        psa.reset_traced_bwd_batch_heads()
+        psa._TRACED_BWD_BATCH_HEADS.add(True)
+        assert bench._attn_bwd_record_fields(
+            _bench_args(attn_bwd="batched")
+        ) == {"attn_bwd": "batched"}
+
+        # Default loop traced as loop: no extra record fields at all.
+        psa.reset_traced_bwd_batch_heads()
+        psa._TRACED_BWD_BATCH_HEADS.add(False)
+        assert bench._attn_bwd_record_fields(_bench_args()) == {}
+    finally:
+        psa.reset_traced_bwd_batch_heads()
+
+
 @pytest.mark.smoke
 def test_unsignaled_shield_reemits_child_record():
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -4,10 +4,16 @@ Run as subprocesses (the CLI owns its own platform bring-up, like the reference'
 ``__main__`` harnesses, /root/reference/test_distributed_sigmoid_loss.py:144-148).
 """
 
+import pytest
+
 import json
 import os
 import subprocess
 import sys
+
+# Tier note: excluded from the time-boxed tier-1 gate (-m 'not slow'): multi-minute end-to-end CLI subprocess drills.
+pytestmark = pytest.mark.slow
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -21,11 +21,15 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_sigmoid_loss_tpu.parallel.compression import (
+
     compressed_axis_mean,
     dequantize_tensor_int8,
     init_error_feedback,
     quantize_tensor_int8,
 )
+
+# Tier note: excluded from the time-boxed tier-1 gate (-m 'not slow'): multi-minute compression/parity sweeps.
+pytestmark = pytest.mark.slow
 
 
 def hybrid_mesh(dcn=2, dp=4):

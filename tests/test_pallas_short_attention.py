@@ -84,6 +84,34 @@ def test_batched_bwd_matches_per_head_loop(b, s, h, dh, causal):
         )
 
 
+def test_traced_bwd_choice_is_recorded_at_trace_time():
+    """The bench record cross-check's data source: tracing the backward must
+    record the kernel choice RESOLVED (default or explicit), so a step traced
+    before a set_bwd_batch_heads flip is detectable (advisor, round 5)."""
+    from distributed_sigmoid_loss_tpu.ops import pallas_short_attention as psa
+
+    rng = np.random.default_rng(3)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 8, 2, 4)), jnp.float32)
+        for _ in range(3)
+    )
+    psa.reset_traced_bwd_batch_heads()
+    try:
+        assert psa.traced_bwd_batch_heads() == ()
+        jax.grad(
+            lambda q: jnp.sum(short_self_attention(q, k, v, False, None, True))
+        )(q)
+        assert psa.traced_bwd_batch_heads() == (False,)  # default: per-head loop
+        jax.grad(
+            lambda q: jnp.sum(
+                short_self_attention(q, k, v, False, None, True, True)
+            )
+        )(q)
+        assert psa.traced_bwd_batch_heads() == (False, True)  # mixed → detectable
+    finally:
+        psa.reset_traced_bwd_batch_heads()
+
+
 def test_batched_bwd_fits_check():
     from distributed_sigmoid_loss_tpu.ops.pallas_short_attention import (
         short_attention_bwd_batched_fits,

@@ -26,10 +26,14 @@ from distributed_sigmoid_loss_tpu.train import (
     make_train_step,
 )
 from distributed_sigmoid_loss_tpu.utils.config import (
+
     LossConfig,
     SigLIPConfig,
     TrainConfig,
 )
+
+# Tier note: excluded from the time-boxed tier-1 gate (-m 'not slow'): multi-minute pipelined-tower parity oracles.
+pytestmark = pytest.mark.slow
 
 
 def pp_config(depth=4):

@@ -1,0 +1,410 @@
+"""serve/ subsystem: batching, caching, bucketed compile discipline, retrieval
+parity.
+
+The serving contracts under test, in dependency order:
+
+- MicroBatcher: concurrent producers coalesce into one engine call; a partial
+  batch flushes at the deadline; a full bounded queue rejects with the typed
+  backpressure error (never unbounded growth).
+- EmbeddingCache: hit/miss/eviction accounting, content-hash keying.
+- InferenceEngine: 100 mixed-size requests never compile outside the warmed
+  bucket grid (compile_count == bucket_space, cross-checked against the jit
+  layer's own cache counter).
+- RetrievalIndex: chunked exact top-k is IDENTICAL to eval.retrieval's shared
+  ranking helper, position-consistent with retrieval_ranks on a tie-free
+  fixture, and deterministic (lower id) under exact ties.
+- EmbeddingService + serve-bench CLI: end-to-end stats schema over the real
+  tiny towers.
+
+Everything runs on CPU; the only compiles are the tiny-config engine fixture's
+six bucket programs (module-scoped, compiled once).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.serve import (
+    EmbeddingCache,
+    EmbeddingService,
+    InferenceEngine,
+    MicroBatcher,
+    QueueFullError,
+    RequestTimeoutError,
+    RetrievalIndex,
+    content_key,
+)
+
+# ---------------------------------------------------------------------------
+# MicroBatcher (no jax involved: run_batch is plain python)
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_coalesces_concurrent_producers():
+    """Items queued while the engine is busy coalesce into multi-item batches."""
+    release = threading.Event()
+    calls = []
+
+    def run_batch(items):
+        if not calls:  # hold the FIRST batch until every producer has queued
+            release.wait(timeout=10)
+        calls.append(len(items))
+        return [x * 2 for x in items]
+
+    with MicroBatcher(run_batch, max_batch_size=16, max_wait_ms=50) as mb:
+        futures = []
+        threads = [
+            threading.Thread(
+                target=lambda base: futures.extend(
+                    mb.submit(base + j) for j in range(8)
+                ),
+                args=(100 * t,),
+            )
+            for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        release.set()
+        results = [f.result(timeout=10) for f in futures]
+
+    assert sorted(results) == sorted((100 * t + j) * 2 for t in range(4) for j in range(8))
+    assert sum(calls) == 32
+    # The 31 items queued behind the gated first batch must coalesce.
+    assert max(calls) > 1
+    assert mb.batch_size_histogram() == {
+        size: calls.count(size) for size in set(calls)
+    }
+
+
+def test_batcher_deadline_flushes_partial_batch():
+    """A batch far below max_batch_size still flushes once max_wait_ms passes."""
+    calls = []
+
+    def run_batch(items):
+        calls.append(len(items))
+        return items
+
+    with MicroBatcher(run_batch, max_batch_size=64, max_wait_ms=30) as mb:
+        t0 = time.monotonic()
+        futs = [mb.submit(i) for i in range(3)]
+        assert [f.result(timeout=5) for f in futs] == [0, 1, 2]
+        elapsed = time.monotonic() - t0
+    assert sum(calls) == 3
+    # Flushed by the deadline, not by a full batch — and the deadline is the
+    # FIRST item's, so the whole wait stays O(max_wait), not O(n * max_wait).
+    assert elapsed < 5.0
+
+
+def test_batcher_backpressure_rejects_when_queue_full():
+    release = threading.Event()
+    started = threading.Event()
+
+    def run_batch(items):
+        started.set()
+        release.wait(timeout=10)
+        return items
+
+    mb = MicroBatcher(run_batch, max_batch_size=1, max_wait_ms=0, max_queue=2)
+    try:
+        first = mb.submit("a")  # worker takes it and blocks in run_batch
+        assert started.wait(timeout=5)
+        q1, q2 = mb.submit("b"), mb.submit("c")  # fill the bounded queue
+        with pytest.raises(QueueFullError):
+            mb.submit("overflow")
+        release.set()
+        assert first.result(timeout=5) == "a"
+        assert (q1.result(timeout=5), q2.result(timeout=5)) == ("b", "c")
+    finally:
+        release.set()
+        mb.close()
+
+
+def test_batcher_propagates_engine_errors_to_all_futures():
+    def run_batch(items):
+        raise ValueError("engine exploded")
+
+    with MicroBatcher(run_batch, max_batch_size=8, max_wait_ms=5) as mb:
+        futs = [mb.submit(i) for i in range(3)]
+        for f in futs:
+            with pytest.raises(ValueError, match="engine exploded"):
+                f.result(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# EmbeddingCache
+# ---------------------------------------------------------------------------
+
+
+def test_cache_hit_miss_eviction_accounting():
+    cache = EmbeddingCache(capacity=2)
+    a, b, c = (np.full(4, v, np.float32) for v in (1.0, 2.0, 3.0))
+    ka, kb, kc = (content_key(x, "text") for x in (a, b, c))
+    assert ka != kb != kc
+
+    assert cache.get(ka) is None  # miss
+    cache.put(ka, a)
+    cache.put(kb, b)
+    np.testing.assert_array_equal(cache.get(ka), a)  # hit; refreshes LRU order
+    cache.put(kc, c)  # evicts b (least recent), not a
+    assert cache.get(kb) is None
+    np.testing.assert_array_equal(cache.get(ka), a)
+    np.testing.assert_array_equal(cache.get(kc), c)
+
+    s = cache.stats()
+    assert (s["hits"], s["misses"], s["evictions"]) == (3, 2, 1)
+    assert s["size"] == 2 and s["hit_rate"] == round(3 / 5, 4)
+
+
+def test_content_key_separates_dtype_shape_namespace():
+    x = np.arange(6, dtype=np.int32)
+    assert content_key(x) != content_key(x.astype(np.int64))
+    assert content_key(x) != content_key(x.reshape(2, 3))
+    assert content_key(x, "text") != content_key(x, "image")
+    assert content_key("caption") == content_key("caption")
+
+
+# ---------------------------------------------------------------------------
+# Engine + service over the real tiny towers (module-scoped: compile once)
+# ---------------------------------------------------------------------------
+
+BUCKETS = (1, 4, 8)
+CTX = 8  # tiny config's context_length
+
+
+@pytest.fixture(scope="module")
+def engine():
+    import jax
+    from flax import linen as nn
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    imgs = np.zeros((1, 16, 16, 3), np.float32)
+    toks = np.zeros((1, CTX), np.int32)
+    params = nn.meta.unbox(
+        model.init(jax.random.key(0), imgs, toks)["params"]
+    )
+    eng = InferenceEngine.from_model(model, params, batch_buckets=BUCKETS)
+    eng.warmup()
+    return eng
+
+
+def test_engine_compile_count_constant_across_100_mixed_requests(engine):
+    warmed = engine.compile_count
+    assert warmed == engine.bucket_space == len(BUCKETS) * 2
+
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        n = int(rng.integers(1, BUCKETS[-1] + 1))
+        s = int(rng.integers(1, CTX + 1))
+        engine.encode_text(rng.integers(0, 64, (n, s), dtype=np.int32))
+    for _ in range(50):
+        n = int(rng.integers(1, BUCKETS[-1] + 1))
+        engine.encode_image(
+            rng.standard_normal((n, 16, 16, 3)).astype(np.float32)
+        )
+    # 100 mixed-size requests later: not one fresh program.
+    assert engine.compile_count == warmed
+    jit_n = engine.jit_cache_size()
+    if jit_n is not None:  # the jit layer agrees our counter is honest
+        assert jit_n == warmed
+
+
+def test_engine_padding_does_not_perturb_real_rows(engine):
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, 64, (3, CTX), dtype=np.int32)
+    one_by_one = np.stack([engine.encode_text(t)[0] for t in toks])
+    batched = engine.encode_text(toks)  # pads 3 -> bucket 4
+    np.testing.assert_allclose(batched, one_by_one, rtol=1e-5, atol=1e-6)
+
+
+def test_engine_rejects_out_of_grid_shapes(engine):
+    with pytest.raises(ValueError, match="largest bucket"):
+        engine.encode_text(np.zeros((BUCKETS[-1] + 1, CTX), np.int32))
+    with pytest.raises(ValueError, match="largest bucket"):
+        engine.encode_text(np.zeros((1, CTX + 1), np.int32))
+    with pytest.raises(ValueError, match="shape"):
+        engine.encode_image(np.zeros((1, 8, 8, 3), np.float32))
+
+
+def test_service_end_to_end_cache_and_stats(engine):
+    rng = np.random.default_rng(2)
+    toks = rng.integers(0, 64, (4, CTX), dtype=np.int32)
+    with EmbeddingService(
+        engine, cache=EmbeddingCache(64), max_wait_ms=5.0
+    ) as svc:
+        e1 = svc.encode_text(toks)
+        e2 = svc.encode_text(toks)  # every row cached now
+        np.testing.assert_array_equal(e1, e2)
+        np.testing.assert_allclose(
+            e1, engine.encode_text(toks), rtol=1e-5, atol=1e-6
+        )
+        assert svc.cache.stats() == {
+            **svc.cache.stats(), "hits": 4, "misses": 4,
+        }
+
+        svc.index.add(e1)
+        scores, ids = svc.search(toks[2], k=1)
+        assert ids[0, 0] == 2
+
+        snap = svc.stats()
+        for key in ("qps", "latency_ms", "batch_size_hist", "cache",
+                    "compile_count", "bucket_space", "requests"):
+            assert key in snap, key
+        assert snap["compile_count"] == engine.bucket_space
+        assert set(snap["latency_ms"]) == {"p50_ms", "p95_ms"}
+        assert json.dumps(snap)  # snapshot must be JSON-serializable as-is
+
+
+def test_service_concurrent_clients_coalesce(engine):
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, 64, (32, CTX), dtype=np.int32)
+    with EmbeddingService(engine, max_wait_ms=20.0) as svc:
+        results = [None] * 8
+
+        def client(i):
+            results[i] = svc.encode_text(toks[4 * i : 4 * i + 4])
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        flat = np.concatenate(results)
+        want = np.concatenate(  # direct engine reference, in bucket-sized cuts
+            [engine.encode_text(toks[i : i + 8]) for i in range(0, 32, 8)]
+        )
+        np.testing.assert_allclose(flat, want, rtol=1e-5, atol=1e-6)
+        hist = svc.stats()["batch_size_hist"]["text"]
+        assert sum(size * n for size, n in hist.items()) == 32
+
+
+def test_service_timeout_raises_typed_error(engine):
+    release = threading.Event()
+
+    def gated(items):
+        release.wait(timeout=10)
+        return items
+
+    with EmbeddingService(engine, max_wait_ms=1.0) as svc:
+        # Swap the text batcher for a gated one: the engine never gets the
+        # request before the caller's deadline.
+        svc._batchers["text"].close()
+        svc._batchers["text"] = MicroBatcher(gated, max_wait_ms=1.0)
+        try:
+            with pytest.raises(RequestTimeoutError):
+                svc.encode_text(np.zeros(CTX, np.int32), timeout=0.05)
+            assert svc.stats()["timeouts"] == 1
+        finally:
+            release.set()
+
+
+# ---------------------------------------------------------------------------
+# RetrievalIndex vs eval/retrieval.py — the shared ranking contract
+# ---------------------------------------------------------------------------
+
+
+def _l2(x):
+    return x / np.linalg.norm(x, axis=-1, keepdims=True)
+
+
+def test_index_topk_matches_eval_ranking_helper_chunked_and_not():
+    from distributed_sigmoid_loss_tpu.eval.retrieval import topk_ids
+
+    rng = np.random.default_rng(4)
+    corpus = _l2(rng.standard_normal((67, 16)).astype(np.float32))
+    queries = _l2(rng.standard_normal((9, 16)).astype(np.float32))
+    want = topk_ids(queries @ corpus.T, 5)
+
+    for chunk in (1000, 16, 7, 1):  # incl. chunks that straddle add-blocks
+        idx = RetrievalIndex(chunk_size=chunk)
+        idx.add(corpus[:30])  # two add-blocks: chunking must cross them
+        idx.add(corpus[30:])
+        scores, ids = idx.search(queries, 5)
+        np.testing.assert_array_equal(ids, want)
+        # Ordering is EXACT; scores allow BLAS kernel-shape rounding (gemv vs
+        # gemm at chunk_size=1), orders of magnitude below any sim gap here.
+        np.testing.assert_allclose(
+            scores, np.take_along_axis(queries @ corpus.T, want, axis=1),
+            rtol=1e-6,
+        )
+
+
+def test_index_position_equals_retrieval_ranks(engine):
+    """The online index and the offline eval agree: on a tie-free fixture the
+    positive's position in search() equals retrieval_ranks' strictly-greater
+    count — computed over REAL tiny-tower embeddings, the shared fixture."""
+    from distributed_sigmoid_loss_tpu.eval.retrieval import retrieval_ranks
+
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, 64, (8, CTX), dtype=np.int32)
+    imgs = rng.standard_normal((8, 16, 16, 3)).astype(np.float32)
+    ztxt = engine.encode_text(toks)
+    zimg = engine.encode_image(imgs)
+
+    ranks = np.asarray(retrieval_ranks(zimg, ztxt))
+    idx = RetrievalIndex(chunk_size=3)
+    idx.add(ztxt)
+    _, ids = idx.search(zimg, k=8)
+    positions = np.array([int(np.where(ids[i] == i)[0][0]) for i in range(8)])
+    np.testing.assert_array_equal(positions, ranks)
+
+
+def test_index_breaks_exact_ties_deterministically():
+    row = _l2(np.ones((1, 8), np.float32))
+    corpus = np.concatenate([row, row, row])  # ids 0,1,2 all score identically
+    for chunk in (10, 1):
+        idx = RetrievalIndex(chunk_size=chunk)
+        idx.add(corpus)
+        scores, ids = idx.search(row, k=3)
+        np.testing.assert_array_equal(ids, [[0, 1, 2]])  # lower id wins
+        assert scores[0, 0] == scores[0, 1] == scores[0, 2]
+
+
+def test_index_validates_inputs():
+    idx = RetrievalIndex()
+    with pytest.raises(ValueError, match="empty"):
+        idx.search(np.ones(4, np.float32), k=1)
+    idx.add(np.eye(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="dim"):
+        idx.add(np.ones((1, 5), np.float32))
+    _, ids = idx.search(np.ones(4, np.float32), k=100)  # k clamps to size
+    assert ids.shape == (4,)
+
+
+# ---------------------------------------------------------------------------
+# serve-bench CLI — the acceptance entry point, scaled down for CI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_serve_bench_prints_stats_snapshot(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_sigmoid_loss_tpu", "serve-bench",
+         "--requests", "48", "--clients", "4", "--pool", "16",
+         "--index-size", "16", "--batch-buckets", "1,4,8"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["metric"] == "serve_bench"
+    assert record["requests"] == 48
+    for key in ("qps", "latency_ms", "batch_size_hist", "cache"):
+        assert key in record, key
+    assert 0.0 <= record["cache"]["hit_rate"] <= 1.0
+    # The serving contract: compiles == warmed shape buckets, NOT requests.
+    assert record["compile_count"] == record["bucket_space"] == 3 * 2
+    assert record["compile_count"] < record["requests"]

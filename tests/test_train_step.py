@@ -15,10 +15,14 @@ from distributed_sigmoid_loss_tpu.train import (
     make_train_step,
 )
 from distributed_sigmoid_loss_tpu.utils.config import (
+
     LossConfig,
     SigLIPConfig,
     TrainConfig,
 )
+
+# Tier note: excluded from the time-boxed tier-1 gate (-m 'not slow'): multi-minute end-to-end train-step oracles.
+pytestmark = pytest.mark.slow
 
 
 def tiny_batch(global_b, cfg, seed=0):
