@@ -221,6 +221,11 @@ def _fresh_compile_config(args) -> bool:
         # stash variant differs again — neither sits in the warm cache.
         or args.accum_negatives != "local"
         or args.gradcache_bf16
+        # The STE-quantized train step swaps every projection dot for the
+        # int8 custom_vjp program — by definition not in the warm cache of
+        # routine bf16 headline runs (same bug class as the round-5
+        # --gradcache-bf16 finding).
+        or bool(args.quant_train)
     )
 
 
@@ -1066,6 +1071,12 @@ def main():
     ap.add_argument("--quant", default="", choices=["", "int8"],
                     help="with --eval-throughput: dynamic int8 projection "
                          "matmuls (v5e int8 MXU = 2x bf16 peak)")
+    ap.add_argument("--quant-train", default="", choices=["", "int8"],
+                    help="TRAIN bench with STE-quantized towers: int8 "
+                         "projection matmuls forward (the 2x-bf16 MXU gear), "
+                         "full-precision VJP backward — the int8 training "
+                         "track's headline lever (docs/PERF.md roofline "
+                         "rationale); recipes tag records via --metric-suffix")
     ap.add_argument("--context", type=int, default=0, metavar="SEQ",
                     help="long-context attention bench INSTEAD of the train "
                          "bench: time one transformer block fwd+bwd at this "
@@ -1084,7 +1095,14 @@ def main():
     if args.quant and not args.eval_throughput:
         ap.error("--quant without --eval-throughput would be a silent no-op "
                  "(the train bench never quantizes: training through round() "
-                 "has zero gradients)")
+                 "has zero gradients; --quant-train int8 is the trainable "
+                 "STE path)")
+    if args.quant and args.quant_train:
+        ap.error("--quant (inference PTQ, --eval-throughput) and "
+                 "--quant-train (STE train bench) are mutually exclusive")
+    if args.quant_train and (args.context or args.moe_breakdown):
+        ap.error("--quant-train applies to the train bench only (the "
+                 "context/MoE breakdowns build their own block programs)")
     if args.attn_bwd == "batched":
         # Process default, baked in at trace time — set before ANY step build.
         from distributed_sigmoid_loss_tpu.ops.pallas_short_attention import (
@@ -1121,11 +1139,13 @@ def main():
             "--accum-negatives": args.accum_negatives != "local",
             "--gradcache-bf16": args.gradcache_bf16,
             "--attn-bwd": args.attn_bwd != "loop",
+            "--quant-train": bool(args.quant_train),
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:
             ap.error(f"--eval-throughput does not support {' '.join(bad)} "
-                     "(forward-only: no loss, no optimizer)")
+                     "(forward-only: no loss, no optimizer; PTQ serving is "
+                     "--quant int8)")
     if args.steps_per_call < 1 or args.steps % args.steps_per_call:
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")
@@ -1153,6 +1173,7 @@ def main():
             "--steps-per-call": args.steps_per_call != 1,
             "--accum-negatives": args.accum_negatives != "local",
             "--gradcache-bf16": args.gradcache_bf16,
+            "--quant-train": bool(args.quant_train),
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:
@@ -1246,6 +1267,14 @@ def main():
             cfg,
             vision=dataclasses.replace(cfg.vision, remat_policy=args.remat_policy),
             text=dataclasses.replace(cfg.text, remat_policy=args.remat_policy),
+        )
+    if args.quant_train:
+        # STE-quantized towers: int8 forward on the MXU, full-precision VJP
+        # (make_train_step accepts quant_train; inference `quant` it rejects).
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(cfg.vision, quant_train=args.quant_train),
+            text=dataclasses.replace(cfg.text, quant_train=args.quant_train),
         )
     model = SigLIP(cfg)
     tx = make_optimizer(
@@ -1438,6 +1467,8 @@ def main():
             record["moe_group_size"] = args.moe_group_size
         if args.moe_cf is not None:
             record["moe_capacity_factor"] = args.moe_cf
+    if args.quant_train:
+        record["quant_train"] = args.quant_train
     if args.zero1:
         record["zero1"] = True
     if args.mu_bf16:

@@ -56,6 +56,13 @@ def install() -> None:
     if not hasattr(jax.lax, "axis_size"):
 
         def axis_size(axis_name):
+            # 0.6 accepts a tuple of bound axes (size = product) — the
+            # compressed step's joint (dcn, dp) loss axis uses that form.
+            if isinstance(axis_name, (tuple, list)):
+                size = 1
+                for name in axis_name:
+                    size *= axis_size(name)
+                return size
             return jax.core.axis_frame(axis_name)
 
         jax.lax.axis_size = axis_size

@@ -94,6 +94,19 @@ def _model_config(args):
             vision=dataclasses.replace(cfg.vision, quant=args.quant),
             text=dataclasses.replace(cfg.text, quant=args.quant),
         )
+    if getattr(args, "quant_train", ""):
+        # Trainable int8 (train subcommand): same dynamic int8 forward through
+        # the straight-through estimator — backward stays full-precision
+        # (ops/quant.py int8_dot_general_ste), so the step trains normally.
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(
+                cfg.vision, quant_train=args.quant_train
+            ),
+            text=dataclasses.replace(cfg.text, quant_train=args.quant_train),
+        )
     if getattr(args, "remat_policy", ""):
         # Same override bench.py carries: the measured-best policies are
         # per-model AND per-batch (docs/PERF.md round-4 sweep), so the train
@@ -1452,6 +1465,12 @@ def main(argv=None) -> int:
                          "model config's own; measured winners per shape in "
                          "docs/PERF.md — e.g. save_hot for b16/l14 "
                          "microbatch-128 recipes, save_mlp for so400m)")
+    tr.add_argument("--quant-train", choices=["", "int8"], default="",
+                    help="trainable int8: block projection matmuls run the "
+                         "dynamic symmetric int8 recipe FORWARD (v5e int8 "
+                         "MXU = 2x bf16 peak) with the full-precision VJP "
+                         "BACKWARD (straight-through estimator) — the int8 "
+                         "training track (docs/PERF.md roofline rationale)")
     tr.add_argument("--accum-negatives", choices=["local", "global"],
                     default="local",
                     help="with --accum > 1: 'local' contrasts each microbatch "

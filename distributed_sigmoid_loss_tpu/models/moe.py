@@ -124,26 +124,33 @@ def build_dispatch(
 def expert_apply(xg, dispatch, combine, wi, wo, dtype, quant=False):
     """Dispatch-einsum → per-expert MLP → combine-einsum (model dtype).
 
-    ``quant=True`` runs the two expert MLP matmuls in dynamic int8
-    (ops/quant.py int8_expert_matmul — inference only, like the dense towers'
-    quant flag); dispatch/combine stay in the model dtype (one-hot routing,
-    <20% of layer FLOPs).
+    ``quant="int8"`` (legacy ``True``) runs the two expert MLP matmuls in
+    dynamic int8 (ops/quant.py int8_expert_matmul — inference only, like the
+    dense towers' quant flag); ``quant="int8_ste"`` uses the trainable
+    straight-through twin (int8 forward, unquantized VJP). Dispatch/combine
+    stay in the model dtype either way (one-hot routing, <20% of layer FLOPs).
     """
     expert_in = jnp.einsum(
         "ntec,ntd->encd", dispatch.astype(dtype), xg.astype(dtype)
     )
     if quant:
-        from distributed_sigmoid_loss_tpu.ops.quant import int8_expert_matmul
+        from distributed_sigmoid_loss_tpu.ops.quant import (
+            int8_expert_matmul,
+            int8_expert_matmul_ste,
+        )
 
+        matmul = (
+            int8_expert_matmul_ste if quant == "int8_ste" else int8_expert_matmul
+        )
         # Same checkpoint tag as the dense path (moot at inference, but the
         # remat policies stay total over block variants).
         hidden_act = checkpoint_name(
-            int8_expert_matmul(expert_in, wi, dtype), "mlp_hidden"
+            matmul(expert_in, wi, dtype), "mlp_hidden"
         )
         h = nn.gelu(hidden_act, approximate=True)
         return jnp.einsum(
             "ntec,encd->ntd", combine.astype(dtype),
-            int8_expert_matmul(h, wo, dtype),
+            matmul(h, wo, dtype),
         )
     # Same checkpoint tag as the dense Mlp (transformer.py): the save_hot /
     # save_mlp remat policies keep the expert hidden activation, so backward
@@ -183,7 +190,8 @@ class MoeMlp(nn.Module):
     # bench scale (50k tokens/step) single-group routing OOMs 16G HBM. The
     # actual group is the largest divisor of the token count ≤ this target.
     group_size: int = 512
-    quant: bool = False  # int8 expert MLP matmuls (inference only)
+    # "" | "int8" (inference) | "int8_ste" (trainable STE) expert MLP matmuls.
+    quant: bool | str = False
 
     @nn.compact
     def __call__(self, x):

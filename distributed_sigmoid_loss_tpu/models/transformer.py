@@ -29,12 +29,19 @@ def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
 
 
-def _dot_general(quant: bool):
-    """None = flax's default (lax.dot_general); int8 path when quantized."""
+def _dot_general(quant):
+    """None = flax's default (lax.dot_general). ``"int8"`` (or legacy ``True``)
+    injects the inference-only int8 dot; ``"int8_ste"`` the trainable
+    straight-through variant (int8 forward, unquantized VJP — ops/quant.py)."""
     if not quant:
         return None
-    from distributed_sigmoid_loss_tpu.ops.quant import int8_dot_general
+    from distributed_sigmoid_loss_tpu.ops.quant import (
+        int8_dot_general,
+        int8_dot_general_ste,
+    )
 
+    if quant == "int8_ste":
+        return int8_dot_general_ste
     return int8_dot_general
 
 
@@ -67,7 +74,7 @@ class Mlp(nn.Module):
     # to the exact integer.
     mlp_ratio: int | float
     dtype: Any
-    quant: bool = False  # int8 projection matmuls (inference only; ops/quant.py)
+    quant: bool | str = False  # "" | "int8" | "int8_ste" (see _dot_general)
 
     @nn.compact
     def __call__(self, x):
@@ -116,7 +123,7 @@ class Attention(nn.Module):
     sp_impl: str = "ring"  # "ring" (ppermute) or "ulysses" (all-to-all)
     attn_impl: str = "auto"  # "dense" | "flash" | "auto"
     causal: bool = False
-    quant: bool = False  # int8 projection matmuls (inference only; ops/quant.py)
+    quant: bool | str = False  # "" | "int8" | "int8_ste" (see _dot_general)
 
     @nn.compact
     def __call__(self, x_q, x_kv=None):
@@ -244,7 +251,7 @@ class Block(nn.Module):
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
     moe_group_size: int = 512
-    quant: bool = False
+    quant: bool | str = False
 
     @nn.compact
     def __call__(self, x):
@@ -290,7 +297,7 @@ class _ScanBody(nn.Module):
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
     moe_group_size: int = 512
-    quant: bool = False
+    quant: bool | str = False
 
     @nn.compact
     def __call__(self, carry, _):
@@ -330,7 +337,7 @@ class Encoder(nn.Module):
     moe_num_selected: int = 1
     moe_capacity_factor: float = 1.25
     moe_group_size: int = 512
-    quant: bool = False
+    quant: bool | str = False
 
     @nn.compact
     def __call__(self, x):

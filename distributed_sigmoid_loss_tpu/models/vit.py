@@ -21,7 +21,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_sigmoid_loss_tpu.models.transformer import Encoder, MapHead, _dtype
-from distributed_sigmoid_loss_tpu.utils.config import ViTConfig
+from distributed_sigmoid_loss_tpu.utils.config import ViTConfig, tower_quant_mode
 
 
 class PatchEmbed(nn.Module):
@@ -91,7 +91,7 @@ class ViT(nn.Module):
             moe_experts=cfg.moe_experts,
             moe_num_selected=cfg.moe_num_selected,
             moe_capacity_factor=cfg.moe_capacity_factor,
-            moe_group_size=cfg.moe_group_size, quant=(cfg.quant == "int8"),
+            moe_group_size=cfg.moe_group_size, quant=tower_quant_mode(cfg),
             name="encoder",
         )(x)
 

@@ -18,10 +18,21 @@ The integration point is flax's ``nn.Dense(dot_general=...)`` injection —
 the param tree is untouched, so ANY trained/imported checkpoint can be served
 quantized by flipping ``quant="int8"`` on the tower config (utils/config.py).
 
-NOT for training: ``round`` has zero gradient almost everywhere, so a
-quantized tower trains to a standstill silently. The config guard in the
-towers rejects quant + trainable contexts; there is no straight-through
-estimator here (add one if QAT ever becomes a target).
+Two gears, one recipe:
+
+- ``int8_dot_general`` — inference. ``round`` has zero gradient almost
+  everywhere, so a tower quantized with THIS dot trains to a standstill
+  silently; the train-step guard rejects ``quant`` configs in trainable
+  contexts.
+- ``int8_dot_general_ste`` — training. The standard low-precision-training
+  fix: a straight-through estimator (``jax.custom_vjp``) whose forward is
+  bit-identical to ``int8_dot_general`` (the MXU's int8 gear) and whose
+  backward is EXACTLY the unquantized ``lax.dot_general`` VJP on the saved
+  full-precision operands — the gradient the bf16/f32 layer would have
+  produced for the same cotangent. This is what breaks the bf16 roofline
+  (docs/PERF.md "Why an int8 training track"): the v5e int8 MXU peak is 2x
+  bf16, and the bf16 MFU=1.0 ceiling sits below the 1.5x-A100 target.
+  ``int8_expert_matmul_ste`` is the MoE-expert analogue.
 
 No reference analogue (the reference has no model/serving layer; SURVEY.md
 §2 C8 documents docs-only coverage there) — this is TPU-first scope beyond it.
@@ -29,10 +40,19 @@ No reference analogue (the reference has no model/serving layer; SURVEY.md
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["int8_dot_general", "int8_expert_matmul", "quantize_int8"]
+__all__ = [
+    "int8_dot_general",
+    "int8_dot_general_ste",
+    "int8_expert_matmul",
+    "int8_expert_matmul_ste",
+    "quantize_int8",
+]
 
 # Symmetric int8: round-to-nearest into [-127, 127] (−128 unused, keeping the
 # scale symmetric so dequant is one multiply).
@@ -116,3 +136,90 @@ def int8_dot_general(lhs, rhs, dimension_numbers, precision=None,
     n_rhs_free = rhs.ndim - 1
     ls_b = ls_free.reshape(ls_free.shape + (1,) * n_rhs_free)
     return (acc.astype(jnp.float32) * ls_b * rs_free).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Straight-through estimators: int8 forward on the MXU, full-precision VJP.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _int8_dot_general_ste(lhs, rhs, dimension_numbers, precision,
+                          preferred_element_type):
+    return int8_dot_general(
+        lhs, rhs, dimension_numbers, precision=precision,
+        preferred_element_type=preferred_element_type,
+    )
+
+
+def _ste_dot_fwd(lhs, rhs, dimension_numbers, precision,
+                 preferred_element_type):
+    out = int8_dot_general(
+        lhs, rhs, dimension_numbers, precision=precision,
+        preferred_element_type=preferred_element_type,
+    )
+    # Residuals are the ORIGINAL operands: the backward is the gradient the
+    # unquantized layer would have produced, not round()'s zero-a.e. one.
+    return out, (lhs, rhs)
+
+
+def _ste_dot_bwd(dimension_numbers, precision, preferred_element_type, res, g):
+    lhs, rhs = res
+    _, vjp = jax.vjp(
+        lambda l, r: lax.dot_general(
+            l, r, dimension_numbers, precision=precision,
+            preferred_element_type=preferred_element_type,
+        ),
+        lhs, rhs,
+    )
+    return vjp(g)
+
+
+_int8_dot_general_ste.defvjp(_ste_dot_fwd, _ste_dot_bwd)
+
+
+def int8_dot_general_ste(lhs, rhs, dimension_numbers, precision=None,
+                         preferred_element_type=None):
+    """Trainable ``lax.dot_general`` drop-in: int8 forward, unquantized VJP.
+
+    Forward is bit-identical to :func:`int8_dot_general` (same fall-through
+    for non-Dense patterns); backward is EXACTLY the ``lax.dot_general`` VJP
+    on the saved full-precision operands (straight-through estimator) — the
+    oracle ``tests/test_quant_train.py`` pins both sides to equality. The
+    keyword wrapper exists because ``jax.custom_vjp`` takes only positional
+    arguments, while flax's ``nn.Dense(dot_general=...)`` injection calls
+    with ``precision=`` by keyword.
+    """
+    return _int8_dot_general_ste(
+        lhs, rhs, dimension_numbers, precision, preferred_element_type
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def int8_expert_matmul_ste(x, w, out_dtype):
+    """STE twin of :func:`int8_expert_matmul` for trainable MoE experts:
+    int8 batched-expert forward, backward = the unquantized einsum VJP."""
+    return int8_expert_matmul(x, w, out_dtype)
+
+
+def _expert_ref(x, w, out_dtype):
+    # The unquantized op the STE backward differentiates — the same batched
+    # dot_general int8_expert_matmul accelerates, in the model dtype.
+    acc = lax.dot_general(
+        x, w, (((x.ndim - 1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    return acc.astype(out_dtype)
+
+
+def _ste_expert_fwd(x, w, out_dtype):
+    return int8_expert_matmul(x, w, out_dtype), (x, w)
+
+
+def _ste_expert_bwd(out_dtype, res, g):
+    x, w = res
+    _, vjp = jax.vjp(lambda xx, ww: _expert_ref(xx, ww, out_dtype), x, w)
+    return vjp(g)
+
+
+int8_expert_matmul_ste.defvjp(_ste_expert_fwd, _ste_expert_bwd)

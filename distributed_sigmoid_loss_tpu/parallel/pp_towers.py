@@ -55,6 +55,7 @@ from distributed_sigmoid_loss_tpu.utils.config import (
     SigLIPConfig,
     TextConfig,
     ViTConfig,
+    tower_quant_mode,
 )
 
 __all__ = [
@@ -115,6 +116,11 @@ def _pipelined_blocks(
     block = Block(
         cfg.width, cfg.num_heads, cfg.mlp_ratio, dtype,
         attn_impl=cfg.attn_impl, causal=causal,
+        # Same dot injection as the scanned tower (incl. the trainable STE
+        # mode) — without this a quantized config would silently run its
+        # pipelined blocks full-precision, and the exactness oracle vs the
+        # plain tower forward would mask nothing else.
+        quant=tower_quant_mode(cfg),
     )
 
     def layer_apply(p, xx):

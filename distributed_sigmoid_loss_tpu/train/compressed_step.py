@@ -79,6 +79,7 @@ from distributed_sigmoid_loss_tpu.train.train_step import (
     is_pp_block_leaf,
     run_gradcache,
     validate_accum_args,
+    validate_trainable_quant,
     zero1_constrain,
 )
 from distributed_sigmoid_loss_tpu.utils.config import LossConfig
@@ -182,6 +183,10 @@ def make_compressed_train_step(
     ``accum_negatives="global"``): store the GradCache embedding stash in
     that dtype — :func:`train_step.run_gradcache`'s contract.
     """
+    # Same trainable-quant rule as make_train_step: inference int8 (zero-grad
+    # round) is refused; the STE quant_train mode trains through this step's
+    # manual region like any other dot.
+    validate_trainable_quant(model)
     acc_dt = validate_accum_args(accum_steps, accum_dtype)
     if accum_negatives not in ("local", "global"):
         raise ValueError(

@@ -31,8 +31,32 @@ from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
 
 __all__ = [
     "make_optimizer", "create_train_state", "init_params", "make_train_step",
-    "zero1_constrain", "is_pp_block_leaf", "TrainState",
+    "zero1_constrain", "is_pp_block_leaf", "validate_trainable_quant",
+    "TrainState",
 ]
+
+
+def validate_trainable_quant(model: nn.Module) -> None:
+    """Reject INFERENCE-quantized towers in trainable contexts — shared by the
+    regular and compressed steps so the rule cannot drift between them.
+
+    ``quant="int8"`` routes the projection matmuls through ``round()``, whose
+    gradient is zero almost everywhere: a quantized tower trains to a
+    standstill silently. ``quant_train="int8"`` is the trainable path — the
+    same int8 forward through the straight-through estimator
+    (ops/quant.py int8_dot_general_ste), whose backward is the exact
+    unquantized VJP — and passes this check.
+    """
+    cfg = getattr(model, "cfg", None)
+    for tower in ("vision", "text"):
+        tcfg = getattr(cfg, tower, None)
+        if getattr(tcfg, "quant", ""):
+            raise ValueError(
+                f"{tower} tower has quant={tcfg.quant!r}: int8 quantization "
+                "is inference-only (zero gradients through round); train "
+                "with quant_train='int8' (STE: int8 forward, full-precision "
+                "backward) or quant='' and quantize at eval/export time"
+            )
 
 
 def is_pp_block_leaf(path, shape, pp_size: int) -> bool:
@@ -506,16 +530,7 @@ def make_train_step(
     bandwidth share of its ~21% tax (docs/PERF.md) at the cost of bf16
     rounding on the island's loss/cotangents.
     """
-    cfg = getattr(model, "cfg", None)
-    for tower in ("vision", "text"):
-        if getattr(getattr(cfg, tower, None), "quant", ""):
-            # round() in the int8 path has zero gradient a.e. — training a
-            # quantized tower silently goes nowhere. Quant is eval/export-only.
-            raise ValueError(
-                f"{tower} tower has quant={getattr(cfg, tower).quant!r}: int8 "
-                "quantization is inference-only (zero gradients through "
-                "round); train with quant='' and quantize at eval/export time"
-            )
+    validate_trainable_quant(model)
     axis = loss_cfg.axis_name
     precision = _precision(loss_cfg.precision)
     # The model's `bias` param plays no role under family="softmax" (zero

@@ -75,6 +75,13 @@ class ViTConfig:
     # symmetric int8 — v5e int8 MXU peak is 2x bf16. INFERENCE ONLY (round()
     # kills gradients); make_train_step rejects quantized configs.
     quant: Literal["", "int8"] = ""
+    # "int8": TRAINABLE int8 — same block projection matmuls and the same
+    # dynamic symmetric recipe in the forward, but through the
+    # straight-through estimator (ops/quant.py int8_dot_general_ste): backward
+    # is the exact unquantized bf16/f32 VJP, so gradients flow. Embeddings,
+    # layernorms, pooling heads, and the loss head stay full-precision.
+    # Mutually exclusive with `quant` (see tower_quant_mode).
+    quant_train: Literal["", "int8"] = ""
 
     @classmethod
     def vit_b16(cls, **kw) -> "ViTConfig":
@@ -127,6 +134,9 @@ class TextConfig:
     # symmetric int8 — v5e int8 MXU peak is 2x bf16. INFERENCE ONLY (round()
     # kills gradients); make_train_step rejects quantized configs.
     quant: Literal["", "int8"] = ""
+    # "int8": trainable int8 via the straight-through estimator — see
+    # ViTConfig.quant_train (same contract, text tower).
+    quant_train: Literal["", "int8"] = ""
 
     @classmethod
     def base(cls, **kw) -> "TextConfig":
@@ -138,6 +148,28 @@ class TextConfig:
             vocab_size=64, context_length=8, width=32, depth=2, num_heads=2,
             embed_dim=16, dtype="float32", remat=False, scan_layers=False,
         )
+
+
+def tower_quant_mode(cfg: "ViTConfig | TextConfig") -> str:
+    """THE quant-mode resolution for a tower config, shared by the live towers
+    (models/vit.py, models/text.py) and the pipelined forward
+    (parallel/pp_towers.py) so the three can never disagree on which dot a
+    config injects. Returns ``""`` (full precision), ``"int8"``
+    (inference-only dynamic int8), or ``"int8_ste"`` (trainable
+    straight-through int8); raises when both flags are set — one tower cannot
+    run two quantization recipes at once.
+    """
+    if cfg.quant and cfg.quant_train:
+        raise ValueError(
+            f"quant={cfg.quant!r} and quant_train={cfg.quant_train!r} are "
+            "mutually exclusive: pick the inference recipe (quant) or the "
+            "trainable STE recipe (quant_train)"
+        )
+    if cfg.quant_train:
+        return "int8_ste"
+    if cfg.quant:
+        return "int8"
+    return ""
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-6 chip measurement queue — the int8 training track's first numbers:
+#   nohup bash docs/round6_chip_queue.sh > /tmp/r6queue.log 2>&1 &
+#
+# Same recovery-waiting discipline as round 5: one bounded probe per cycle
+# until the tunnel answers, then measurements cheapest-first. NEVER signal a
+# running bench process (SIGTERM mid-XLA-compile wedges the tunnel —
+# docs/PERF.md postmortems; --quant-train is a fresh-compile config, so
+# bench.py runs it under the detached compile shield automatically).
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-5 queue.
+while pgrep -f round5_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+set -x
+# 1. bf16 headline + 32k-equiv (cached compiles) — the comparison anchor for
+#    every quant-train record below, banked first.
+python bench.py
+# 2. QUANT-TRAIN HEADLINE: the bf16 sweet-spot recipe with STE int8 towers.
+#    The roofline rationale (docs/PERF.md "Why an int8 training track"): the
+#    bf16 MFU=1.0 ceiling is ~1410 pairs/s < the 1650 target; the int8 MXU
+#    runs at 2x bf16 peak. Record tagged _qt8 so the bf16 headline stream
+#    stays clean.
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --quant-train int8 --metric-suffix _qt8
+# 3. QUANT-TRAIN 32K-EQUIV: the north-star per-chip shape (4096/chip = 32
+#    microbatches of 128, the v5e-8 portion of global 32768) with STE int8.
+python bench.py 4096 5 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --quant-train int8 --metric-suffix _qt8_32k_equiv
+# 4. Unaccumulated A/B at the single-chip sweet spot (isolates the STE dot's
+#    per-matmul win/tax from the accumulation machinery).
+python bench.py 288 10 b16 --quant-train int8 --metric-suffix _qt8_noaccum
+# 5. Step breakdown stays bf16 (the attribution baseline); the quant-train
+#    attribution question is answered by diffing 2 vs 1 and 4 vs the bf16
+#    288-no-accum history (docs/PERF.md round-4 table).

@@ -111,7 +111,7 @@ def _bench_args(**overrides):
     defaults = dict(
         step_breakdown=False, moe_breakdown=False, moe=0, context=0,
         attn_impl="auto", text_attn_impl="", attn_bwd="loop",
-        accum_negatives="local", gradcache_bf16=False,
+        accum_negatives="local", gradcache_bf16=False, quant_train="",
     )
     defaults.update(overrides)
     return argparse.Namespace(**defaults)
@@ -128,6 +128,16 @@ def test_fresh_compile_config_covers_gradcache_variants():
     # The pre-existing triggers still hold.
     assert bench._fresh_compile_config(_bench_args(attn_impl="dense"))
     assert bench._fresh_compile_config(_bench_args(attn_bwd="batched"))
+
+
+def test_fresh_compile_config_covers_quant_train():
+    """Round-6: the STE-quantized train step (--quant-train int8) swaps every
+    projection dot for the int8 custom_vjp program — never in the warm cache
+    of routine bf16 headline runs, so it must run under the compile shield
+    (same bug class as the round-5 --gradcache-bf16 finding)."""
+    bench = _bench_module()
+    assert bench._fresh_compile_config(_bench_args(quant_train="int8"))
+    assert not bench._fresh_compile_config(_bench_args(quant_train=""))
 
 
 class _FakeChild:

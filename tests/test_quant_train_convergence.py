@@ -1,0 +1,194 @@
+"""int8-training heavyweight oracles: compositions + the convergence contract.
+
+The fast STE/plumbing tier is tests/test_quant_train.py; everything here is
+multi-minute on the 1-core CI host and slow-marked from day one (the tier-1
+gate is time-boxed):
+
+- composition with pipeline parallelism: the pp tower forward must inject the
+  SAME STE dot the scanned tower uses (parallel/pp_towers.py), so a
+  quant_train+pp step trains with finite loss;
+- composition with compressed DCN gradient sync: the STE custom_vjp
+  differentiates inside the fully-manual (dcn, dp) region;
+- the CLI surface: ``train --quant-train int8`` runs a CPU smoke train with
+  finite decreasing loss (the acceptance command, tiny-sized);
+- the LOSS-CURVE-PARITY contract vs full precision on the real-data
+  convergence oracle (tests/test_convergence_real_data.py pattern): the
+  tar-shards color-retrieval task must learn to the SAME recall gate under
+  STE int8, and its logged loss curve must track the full-precision run's —
+  the end-to-end proof that the straight-through gradient carries the
+  learning signal, which inference int8 (zero-grad round) provably cannot.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.models import SigLIP
+from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _quant_train_cfg(cfg):
+    return dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, quant_train="int8"),
+        text=dataclasses.replace(cfg.text, quant_train="int8"),
+    )
+
+
+def _tiny_batch(b=8):
+    rng = np.random.default_rng(0)
+    return {
+        "images": jnp.asarray(rng.standard_normal((b, 16, 16, 3)), jnp.float32),
+        "tokens": jnp.asarray(rng.integers(0, 64, (b, 8)), jnp.int32),
+    }
+
+
+def test_quant_train_composes_with_pipeline_parallelism():
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_2d_mesh
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
+
+    cfg = _quant_train_cfg(SigLIPConfig.tiny_test())
+    cfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, scan_layers=True),
+        text=dataclasses.replace(cfg.text, scan_layers=True),
+    )
+    model = SigLIP(cfg)
+    mesh = make_2d_mesh(4, 2, axis_names=("dp", "pp"))
+    batch = _tiny_batch(8)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+    state = create_train_state(
+        jax.random.key(0), model, tx, batch, mesh, pp_axis="pp"
+    )
+    step, shardings = make_train_step(
+        model, mesh, LossConfig(variant="ring"), pp_microbatches=2
+    )
+    try:
+        _, metrics = step(state, jax.device_put(batch, shardings))
+    except Exception as e:  # jaxlib.xla_extension.XlaRuntimeError
+        if "PartitionId" in str(e):
+            # jax 0.4.x cannot SPMD-partition the gpipe+dp compose at all
+            # (pre-existing, quant-independent; same gap test_pp_towers hits
+            # on 0.4.x hosts). The quant_train wiring itself is pinned by the
+            # build succeeding and by the scanned-tower tests.
+            pytest.skip(f"gpipe+dp compose unsupported on this jax: {e}")
+        raise
+    assert np.isfinite(float(metrics["loss"])), float(metrics["loss"])
+
+
+def test_quant_train_composes_with_compressed_dcn_sync():
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_2d_mesh
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_compressed_train_step,
+        make_optimizer,
+        with_error_feedback,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
+
+    model = SigLIP(_quant_train_cfg(SigLIPConfig.tiny_test()))
+    mesh = make_2d_mesh(2, 4, axis_names=("dcn", "dp"))
+    batch = _tiny_batch(8)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+    state = with_error_feedback(
+        create_train_state(jax.random.key(0), model, tx, batch, mesh), mesh
+    )
+    step, shardings = make_compressed_train_step(
+        model, mesh, LossConfig(variant="all_gather")
+    )
+    state, metrics = step(state, jax.device_put(batch, shardings))
+    assert np.isfinite(float(metrics["loss"])), float(metrics["loss"])
+    assert np.isfinite(float(metrics["ef_norm"]))
+
+
+def _loss_curve(stdout):
+    """[(step, loss), ...] from the CLI's JSON-lines metric records."""
+    out = []
+    for line in stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "step" in rec and "loss" in rec:
+            out.append((rec["step"], rec["loss"]))
+    return out
+
+
+def test_cli_train_quant_train_smoke_decreasing_loss():
+    """The acceptance command surface: ``train --quant-train int8`` (tiny,
+    CPU-meshed) exits 0 with a finite, decreasing logged loss curve."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "distributed_sigmoid_loss_tpu", "train",
+            "--cpu-devices", "4", "--tiny", "--quant-train", "int8",
+            "--steps", "10", "--batch", "8", "--lr", "3e-3",
+            "--log-every", "1",
+        ],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    curve = _loss_curve(proc.stdout)
+    assert len(curve) >= 10, proc.stdout[-1500:]
+    losses = [l for _, l in curve]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_quant_train_loss_curve_parity_with_full_precision(tmp_path):
+    """The convergence oracle run twice — full precision and STE int8 — on
+    the same color-retrieval shards. Both must clear the oracle's recall gate
+    (chance is 0.0625; the measured full-precision pipeline reaches 0.94-1.0)
+    and the quant-train loss curve must track the full-precision curve at
+    every logged step: a dead STE (silent zero-grad fallback) flatlines the
+    curve and fails both gates."""
+    from test_convergence_real_data import (
+        _final_recall,
+        _make_dataset,
+        _run_train,
+    )
+
+    _make_dataset(tmp_path, "PNG")
+    plain = _run_train(tmp_path)
+    assert plain.returncode == 0, plain.stderr[-3000:]
+    quant = _run_train(tmp_path, extra=("--quant-train", "int8"))
+    assert quant.returncode == 0, quant.stderr[-3000:]
+
+    i2t_q, t2i_q = _final_recall(quant.stdout)
+    assert i2t_q >= 0.5, (i2t_q, quant.stdout[-1500:])
+    assert t2i_q >= 0.5, (t2i_q, quant.stdout[-1500:])
+    i2t_p, _ = _final_recall(plain.stdout)
+    # Parity within the oracle's own tolerance band: STE int8 may trail full
+    # precision a little, never by the learn/no-learn margin.
+    assert i2t_q >= i2t_p - 0.25, (i2t_q, i2t_p)
+
+    curve_p = dict(_loss_curve(plain.stdout))
+    curve_q = dict(_loss_curve(quant.stdout))
+    shared = sorted(set(curve_p) & set(curve_q))
+    assert shared, (plain.stdout[-800:], quant.stdout[-800:])
+    for step in shared:
+        lp, lq = curve_p[step], curve_q[step]
+        assert np.isfinite(lq), (step, lq)
+        # Loose per-step band — int8 forward noise, not a different training
+        # trajectory class.
+        assert abs(lq - lp) <= 0.5 * max(abs(lp), 0.2), (step, lp, lq)

@@ -384,6 +384,117 @@ def test_index_validates_inputs():
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# export → load_forward → engine: the quantized serving artifact path
+# ---------------------------------------------------------------------------
+
+
+def test_export_quant_artifact_serves_with_ranking_parity(tmp_path):
+    """``export --what forward --quant int8`` round-trips through
+    ``train.load_forward`` into the serving engine:
+
+    - the artifact's embeddings equal the LIVE quantized model's (the export
+      serializes the same int8 program it lowered);
+    - they stay directionally faithful to the fp32 artifact's (the PTQ
+      cosine contract, now across the serialize/deserialize boundary);
+    - retrieval RANKING agrees with the fp32 artifact wherever the fp32
+      ranking is margin-stable (int8 perturbs scores ~1e-2; only genuine
+      near-ties may flip);
+    - the engine stays inside its bucket grid (compile_count == bucket_space).
+
+    Params are reconstructed exactly as cmd_export builds them (same config,
+    same SyntheticImageText batch, same init key), so the artifacts and this
+    process agree on the weights without shipping them in the file.
+    """
+    import dataclasses
+
+    import jax
+    from flax import linen as nn
+
+    from distributed_sigmoid_loss_tpu.cli import main as cli_main
+    from distributed_sigmoid_loss_tpu.data import SyntheticImageText
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.train import load_forward
+    from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+    b = 8
+    fp32_path = str(tmp_path / "fwd_fp32.bin")
+    int8_path = str(tmp_path / "fwd_int8.bin")
+    assert cli_main(
+        ["export", fp32_path, "--what", "forward", "--tiny", "--batch", str(b)]
+    ) == 0
+    assert cli_main(
+        ["export", int8_path, "--what", "forward", "--quant", "int8",
+         "--tiny", "--batch", str(b)]
+    ) == 0
+
+    cfg = SigLIPConfig.tiny_test()
+    ctx = cfg.text.context_length
+    batch = next(iter(SyntheticImageText(cfg, b)))
+    model = SigLIP(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.key(0), batch["images"], batch["tokens"])[
+            "params"
+        ]
+    )
+    imgs = np.asarray(batch["images"], np.float32)
+    toks = np.asarray(batch["tokens"], np.int32)
+
+    def engine_for(path):
+        fwd = load_forward(path)
+        zero_imgs = np.zeros((b, 16, 16, 3), np.float32)
+        zero_toks = np.zeros((b, ctx), np.int32)
+        eng = InferenceEngine(
+            lambda p, im: fwd(p, im, zero_toks)[0],
+            lambda p, tk: fwd(p, zero_imgs, tk)[1],
+            params,
+            batch_buckets=(b,),
+            text_len_buckets=(ctx,),
+            image_shape=(16, 16, 3),
+        )
+        eng.warmup()
+        return eng
+
+    fp_eng, q_eng = engine_for(fp32_path), engine_for(int8_path)
+    zi_f, zt_f = fp_eng.encode_image(imgs), fp_eng.encode_text(toks)
+    zi_q, zt_q = q_eng.encode_image(imgs), q_eng.encode_text(toks)
+    assert fp_eng.compile_count == fp_eng.bucket_space == 2
+    assert q_eng.compile_count == q_eng.bucket_space == 2
+
+    # Artifact == live quantized model: the serialized program is the int8 one.
+    qmodel = SigLIP(
+        dataclasses.replace(
+            cfg,
+            vision=dataclasses.replace(cfg.vision, quant="int8"),
+            text=dataclasses.replace(cfg.text, quant="int8"),
+        )
+    )
+    zi_live, zt_live, _ = qmodel.apply({"params": params}, imgs, toks)
+    np.testing.assert_allclose(zi_q, np.asarray(zi_live), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(zt_q, np.asarray(zt_live), rtol=1e-5, atol=1e-6)
+
+    def cos(a, b_):
+        a, b_ = np.asarray(a, np.float64), np.asarray(b_, np.float64)
+        return np.sum(a * b_, -1) / (
+            np.linalg.norm(a, axis=-1) * np.linalg.norm(b_, axis=-1)
+        )
+
+    assert cos(zi_q, zi_f).min() > 0.99
+    assert cos(zt_q, zt_f).min() > 0.99
+
+    # Ranking parity on margin-stable queries: text→image top-1 must agree
+    # with the fp32 artifact wherever fp32's top-1/top-2 gap exceeds the int8
+    # perturbation scale.
+    fp_idx, q_idx = RetrievalIndex(), RetrievalIndex()
+    fp_idx.add(zi_f)
+    q_idx.add(zi_q)
+    scores_f, ids_f = fp_idx.search(zt_f, k=b)
+    _, ids_q = q_idx.search(zt_q, k=b)
+    stable = (scores_f[:, 0] - scores_f[:, 1]) > 0.02
+    assert stable.any(), scores_f[:, :2]
+    np.testing.assert_array_equal(ids_q[stable, 0], ids_f[stable, 0])
+
+
 def test_cli_serve_bench_prints_stats_snapshot(tmp_path):
     import os
     import subprocess
