@@ -226,6 +226,12 @@ def _fresh_compile_config(args) -> bool:
         # routine bf16 headline runs (same bug class as the round-5
         # --gradcache-bf16 finding).
         or bool(args.quant_train)
+        # Streamed negatives / overlapped ring rebuild the loss island's
+        # program (chunk scan / double-buffered hop loop) — fresh compiles
+        # both, so the A/Bs queued in docs/round7_chip_queue.sh stay
+        # shield-covered.
+        or args.loss_impl != "fused"
+        or args.ring_overlap
     )
 
 
@@ -725,6 +731,7 @@ def run_step_breakdown(args) -> int:
     loss_cfg = LossConfig(
         variant=args.variant, family=args.loss_family,
         precision=args.precision, use_pallas=args.use_pallas,
+        loss_impl=args.loss_impl, ring_overlap=args.ring_overlap,
     )
     state = create_train_state(key, model, tx, batch, mesh)
     step, shardings = make_train_step(model, mesh, loss_cfg)
@@ -739,7 +746,8 @@ def run_step_breakdown(args) -> int:
 
     loss_fn = make_sharded_loss_fn(
         mesh, variant=args.variant, family=args.loss_family,
-        precision=args.precision, use_pallas=args.use_pallas, jit=False,
+        precision=args.precision, use_pallas=args.use_pallas,
+        loss_impl=args.loss_impl, ring_overlap=args.ring_overlap, jit=False,
     )
 
     def full_loss(p, bt):
@@ -837,6 +845,10 @@ def run_step_breakdown(args) -> int:
         "steps": n_steps,
         "device_kind": jax.devices()[0].device_kind,
     }
+    if args.loss_impl != "fused":
+        record["loss_impl"] = args.loss_impl
+    if args.ring_overlap:
+        record["ring_overlap"] = True
     if args.mu_bf16:
         record["adam_mu_dtype"] = "bfloat16"
     record.update(_attn_bwd_record_fields(args))
@@ -977,6 +989,17 @@ def main():
                          "exact-full-negatives accumulation (extra embed pass "
                          "per microbatch) vs plain 'local'")
     ap.add_argument("--variant", default="ring", choices=["ring", "all_gather"])
+    ap.add_argument("--loss-impl", default="fused", choices=["fused", "chunked"],
+                    help="with --variant all_gather: 'chunked' streams the "
+                         "gathered negatives through a scan over W "
+                         "chunk-blocks instead of one fused "
+                         "(local_b, W*local_b) matmul — never materializes "
+                         "the full logits (~W* lower peak loss HBM)")
+    ap.add_argument("--ring-overlap", action="store_true",
+                    help="with --variant ring: double-buffer the hop loop "
+                         "(hop k+1's ppermute issued before hop k's block "
+                         "matmuls) so XLA hides ICI latency behind the MXU; "
+                         "bitwise-same accumulation order as the serial ring")
     ap.add_argument("--loss-family", default="sigmoid",
                     choices=["sigmoid", "softmax"],
                     help="sigmoid = SigLIP (headline); softmax = CLIP/InfoNCE "
@@ -1103,6 +1126,21 @@ def main():
     if args.quant_train and (args.context or args.moe_breakdown):
         ap.error("--quant-train applies to the train bench only (the "
                  "context/MoE breakdowns build their own block programs)")
+    if args.loss_impl != "fused" and args.variant != "all_gather":
+        # Refuse, don't auto-switch: bench's --variant default is an explicit
+        # recorded field — silently flipping it would contaminate the
+        # per-variant record streams.
+        ap.error("--loss-impl chunked requires --variant all_gather (the "
+                 "ring already streams negatives one chunk per hop)")
+    if args.ring_overlap and args.variant != "ring":
+        ap.error("--ring-overlap requires --variant ring (the all-gather "
+                 "loss has no hop loop to overlap)")
+    if args.loss_family != "sigmoid" and (
+        args.loss_impl != "fused" or args.ring_overlap
+    ):
+        ap.error("--loss-impl chunked / --ring-overlap apply to the sigmoid "
+                 "family only (the softmax ring already streams its "
+                 "logsumexp)")
     if args.attn_bwd == "batched":
         # Process default, baked in at trace time — set before ANY step build.
         from distributed_sigmoid_loss_tpu.ops.pallas_short_attention import (
@@ -1140,6 +1178,8 @@ def main():
             "--gradcache-bf16": args.gradcache_bf16,
             "--attn-bwd": args.attn_bwd != "loop",
             "--quant-train": bool(args.quant_train),
+            "--loss-impl": args.loss_impl != "fused",
+            "--ring-overlap": args.ring_overlap,
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:
@@ -1310,6 +1350,7 @@ def main():
     loss_cfg = LossConfig(
         variant=args.variant, family=args.loss_family,
         precision=args.precision, use_pallas=args.use_pallas,
+        loss_impl=args.loss_impl, ring_overlap=args.ring_overlap,
     )
     step, shardings = make_train_step(
         model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1,
@@ -1343,25 +1384,19 @@ def main():
     # be unavailable on some PJRT backends.
     compiled = step.lower(state, batch).compile()
     # Peak device memory of the compiled step (XLA's own accounting):
-    # arguments+outputs+temps+generated code. The number that tells you how
-    # far the config sits from the HBM wall before you hit it mid-run.
-    peak_hbm_gb = None
-    try:
-        mem = compiled.memory_analysis()
-        if mem is not None:
-            peak_hbm_gb = round(
-                (
-                    mem.argument_size_in_bytes
-                    + mem.output_size_in_bytes
-                    + mem.temp_size_in_bytes
-                    + mem.generated_code_size_in_bytes
-                    - mem.alias_size_in_bytes
-                )
-                / 2**30,  # GiB, matching the --context bench's peak_hbm_gb
-                2,
-            )
-    except Exception:
-        pass
+    # arguments+outputs+temps+generated code — via the shared introspection
+    # helper (utils/profiling.py), the same figures the CPU peak-HBM
+    # regression test asserts on. The number that tells you how far the
+    # config sits from the HBM wall before you hit it mid-run.
+    from distributed_sigmoid_loss_tpu.utils.profiling import (
+        memory_stats_of_compiled,
+    )
+
+    mem_stats = memory_stats_of_compiled(compiled)
+    # GiB, matching the --context bench's peak_hbm_gb.
+    peak_hbm_gb = (
+        round(mem_stats["peak_bytes"] / 2**30, 2) if mem_stats else None
+    )
     hw_flops_per_step_per_dev = None
     if spc == 1:
         # Only meaningful unfused: HloCostAnalysis counts a while-loop body
@@ -1469,6 +1504,10 @@ def main():
             record["moe_capacity_factor"] = args.moe_cf
     if args.quant_train:
         record["quant_train"] = args.quant_train
+    if args.loss_impl != "fused":
+        record["loss_impl"] = args.loss_impl
+    if args.ring_overlap:
+        record["ring_overlap"] = True
     if args.zero1:
         record["zero1"] = True
     if args.mu_bf16:

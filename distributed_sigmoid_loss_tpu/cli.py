@@ -440,6 +440,30 @@ def cmd_train(args) -> int:
               "global (only the GradCache path stashes embedding tables)",
               file=sys.stderr)
         return 2
+    if args.loss_impl == "chunked":
+        # Refuse, don't drop: a run claiming the streamed-negatives memory
+        # shape while silently running the ring would invalidate any HBM A/B.
+        if args.variant == "ring":
+            print("--loss-impl chunked applies to the all_gather variant only "
+                  "(the ring already streams negatives one chunk per hop); "
+                  "drop --variant ring or pass --variant all_gather",
+                  file=sys.stderr)
+            return 2
+        if args.ring_overlap:
+            print("--loss-impl chunked (all_gather) and --ring-overlap (ring) "
+                  "select different comm variants; pick one", file=sys.stderr)
+            return 2
+    if args.ring_overlap and args.variant == "all_gather":
+        print("--ring-overlap applies to the ring variant only (the "
+              "all-gather loss has no hop loop to overlap)", file=sys.stderr)
+        return 2
+    if args.loss_family == "softmax" and (
+        args.loss_impl != "fused" or args.ring_overlap
+    ):
+        print("--loss-impl chunked / --ring-overlap apply to the sigmoid "
+              "family only (the softmax ring already streams its logsumexp)",
+              file=sys.stderr)
+        return 2
     if args.dcn_slices > 1 and not args.grad_compression:
         print("--dcn-slices without --grad-compression is a silent no-op: the "
               "regular step already spans slices when the dp axis is built "
@@ -459,6 +483,9 @@ def cmd_train(args) -> int:
             # since round 5; expert PARALLELISM stays with the regular step
             # (no GSPMD all-to-alls inside the manual region).
             reasons.append("no --ep (expert parallelism needs the regular step)")
+        if args.ring_overlap:
+            reasons.append("no --ring-overlap (compressed sync is "
+                           "all_gather-only; there is no ring hop loop)")
         if args.ema_decay is not None:
             reasons.append("no --ema-decay")
         if args.grad_compression == "topk" and not (0 < args.topk_frac <= 1):
@@ -696,7 +723,7 @@ def cmd_train(args) -> int:
                 model,
                 mesh,
                 LossConfig(variant="all_gather", family=args.loss_family,
-                           precision="default"),
+                           precision="default", loss_impl=args.loss_impl),
                 zero1=args.zero1,
                 compression=args.grad_compression,
                 topk_frac=args.topk_frac,
@@ -716,11 +743,19 @@ def cmd_train(args) -> int:
                   file=sys.stderr)
             return 2
     else:
+        # --loss-impl chunked is an all_gather memory shape; an unset --variant
+        # follows it (same convention as --grad-compression selecting
+        # all_gather) — an EXPLICIT ring was already refused above.
+        variant = args.variant or (
+            "all_gather" if args.loss_impl == "chunked" else "ring"
+        )
         step_fn, shardings = make_train_step(
             model,
             mesh,
-            LossConfig(variant=args.variant or "ring",
-                       family=args.loss_family, precision="default"),
+            LossConfig(variant=variant,
+                       family=args.loss_family, precision="default",
+                       loss_impl=args.loss_impl,
+                       ring_overlap=args.ring_overlap),
             accum_steps=args.accum,
             accum_negatives=args.accum_negatives,
             accum_dtype="bfloat16" if args.accum_bf16 else None,
@@ -1440,7 +1475,21 @@ def main(argv=None) -> int:
 
     tr.add_argument("--batch", type=int, default=64, help="global batch size")
     tr.add_argument("--variant", choices=["all_gather", "ring"], default=None,
-                    help="loss comm pattern (default ring; --grad-compression selects all_gather)")
+                    help="loss comm pattern (default ring; --grad-compression "
+                         "and --loss-impl chunked select all_gather)")
+    tr.add_argument("--loss-impl", choices=["fused", "chunked"],
+                    default="fused",
+                    help="all_gather loss memory shape: 'fused' computes the "
+                         "whole (local_b, W*local_b) logits in one matmul; "
+                         "'chunked' streams the gathered negatives through a "
+                         "scan over W chunk-blocks — the full logits matrix "
+                         "is never materialized (~W* lower peak loss HBM, "
+                         "unlocking larger per-chip batches)")
+    tr.add_argument("--ring-overlap", action="store_true",
+                    help="double-buffer the ring loss's hop loop: hop k+1's "
+                         "ppermute is issued before hop k's block matmuls so "
+                         "XLA hides ICI latency behind the MXU (ring variant "
+                         "only; bitwise-same accumulation order)")
     tr.add_argument("--loss-family", choices=["sigmoid", "softmax"],
                     default="sigmoid",
                     help="sigmoid = SigLIP (reference); softmax = CLIP/InfoNCE "

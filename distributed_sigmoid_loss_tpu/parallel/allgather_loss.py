@@ -25,7 +25,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import pairwise_logits, sigmoid_xent
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import (
+    pairwise_logits,
+    sigmoid_loss_chunk_scan,
+    sigmoid_xent,
+)
 
 __all__ = ["allgather_sigmoid_loss"]
 
@@ -39,6 +43,7 @@ def allgather_sigmoid_loss(
     axis_name: str = "dp",
     precision=lax.Precision.HIGHEST,
     use_pallas: bool = False,
+    loss_impl: str = "fused",
 ) -> jax.Array:
     """Per-shard loss of the all-gather variant; call inside ``shard_map``.
 
@@ -47,6 +52,12 @@ def allgather_sigmoid_loss(
       ztxt: (local_b, d) L2-normalized text embeddings of this shard.
       t_prime, bias: replicated learnable scalars (init ``log 10`` / ``-10``).
       axis_name: mesh axis playing the role of the DDP world.
+      loss_impl: ``"fused"`` computes the whole ``(local_b, W·local_b)`` logits
+        block in one MXU matmul; ``"chunked"`` streams the gathered negatives
+        through a ``lax.scan`` over the W chunk-blocks
+        (:func:`~distributed_sigmoid_loss_tpu.ops.sigmoid_loss.sigmoid_loss_chunk_scan`)
+        so the full logits matrix is NEVER materialized — peak loss HBM drops
+        ~W×, which is what unlocks larger ``per_chip_batch`` at big W.
 
     Returns the scalar per-shard loss, normalized by local batch size — identical
     placement of the normalization as the reference (distributed_sigmoid_loss.py:47), so
@@ -55,6 +66,26 @@ def allgather_sigmoid_loss(
     """
     local_b, d = zimg.shape
     w = lax.axis_size(axis_name)
+
+    if loss_impl == "chunked":
+        if use_pallas:
+            raise ValueError(
+                "loss_impl='chunked' streams the gathered negatives block-by-"
+                "block; the fused pallas kernel computes the whole gathered "
+                "matmul — pick one"
+            )
+        # (W, local_b, d) stacked in axis-index order IS the chunk layout; the
+        # positive diagonal lives on this shard's own chunk (i == rank).
+        return sigmoid_loss_chunk_scan(
+            zimg,
+            lax.all_gather(ztxt, axis_name),
+            t_prime,
+            bias,
+            positive_chunk=lax.axis_index(axis_name),
+            precision=precision,
+        )
+    if loss_impl != "fused":
+        raise ValueError(f"unknown loss_impl: {loss_impl!r}")
 
     # (W, local_b, d) stacked in axis-index order, grads reduce-scatter back.
     all_txt = lax.all_gather(ztxt, axis_name)

@@ -33,15 +33,50 @@ def make_per_shard_loss(
     bidir: bool = True,
     precision=lax.Precision.HIGHEST,
     use_pallas: bool = False,
+    loss_impl: Literal["fused", "chunked"] = "fused",
+    ring_overlap: bool = False,
 ) -> Callable:
     """The ONE family/variant dispatch, shared by :func:`make_sharded_loss_fn`
     and the train step — returns ``per_shard(zimg, ztxt, t_prime, bias)`` for
     use inside ``shard_map`` (``bias`` is ignored by the softmax family, which
-    has no bias term)."""
+    has no bias term).
+
+    ``loss_impl="chunked"`` (all-gather sigmoid only) streams the gathered
+    negatives chunk-by-chunk instead of materializing the full
+    ``(local_b, W·local_b)`` logits; ``ring_overlap=True`` (ring sigmoid only)
+    double-buffers the hop loop so the ppermute rides behind the block
+    matmuls. Flag/variant mismatches REFUSE rather than silently no-op — a
+    record or run claiming a memory/overlap recipe that never executed is the
+    config drift these checks exist to prevent.
+    """
     if family not in ("sigmoid", "softmax"):
         raise ValueError(f"unknown family: {family!r}")
     if variant not in ("all_gather", "ring"):
         raise ValueError(f"unknown loss variant: {variant!r}")
+    if loss_impl not in ("fused", "chunked"):
+        raise ValueError(f"unknown loss_impl: {loss_impl!r}")
+    if loss_impl == "chunked" and variant != "all_gather":
+        raise ValueError(
+            "loss_impl='chunked' applies to the all-gather variant only (the "
+            "ring already streams negatives one chunk per hop)"
+        )
+    if ring_overlap and variant != "ring":
+        raise ValueError(
+            "ring_overlap applies to the ring variant only (the all-gather "
+            "variant has no hop loop to overlap)"
+        )
+    if family == "softmax" and (loss_impl != "fused" or ring_overlap):
+        raise ValueError(
+            "loss_impl/ring_overlap apply to the sigmoid family only (the "
+            "softmax ring already streams its logsumexp)"
+        )
+    if use_pallas and loss_impl == "chunked":
+        # Same check lives in allgather_sigmoid_loss for direct callers;
+        # raising HERE keeps it a build-time error, not a trace-time one.
+        raise ValueError(
+            "use_pallas fuses the whole gathered block; loss_impl='chunked' "
+            "streams it — pick one"
+        )
 
     if family == "softmax":
         from distributed_sigmoid_loss_tpu.parallel.contrastive import (
@@ -66,11 +101,12 @@ def make_per_shard_loss(
         return partial(
             allgather_sigmoid_loss,
             axis_name=axis_name, precision=precision, use_pallas=use_pallas,
+            loss_impl=loss_impl,
         )
     return partial(
         ring_sigmoid_loss,
         axis_name=axis_name, bidir=bidir, precision=precision,
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, overlap=ring_overlap,
     )
 
 
@@ -83,6 +119,8 @@ def make_sharded_loss_fn(
     bidir: bool = True,
     precision=lax.Precision.HIGHEST,
     use_pallas: bool = False,
+    loss_impl: Literal["fused", "chunked"] = "fused",
+    ring_overlap: bool = False,
     jit: bool = True,
 ) -> Callable:
     """Build ``loss_fn(params, zimg, ztxt) -> scalar`` over global arrays.
@@ -107,7 +145,8 @@ def make_sharded_loss_fn(
     """
     per_shard = make_per_shard_loss(
         family=family, variant=variant, axis_name=axis_name, bidir=bidir,
-        precision=precision, use_pallas=use_pallas,
+        precision=precision, use_pallas=use_pallas, loss_impl=loss_impl,
+        ring_overlap=ring_overlap,
     )
 
     def shard_loss(params, zimg, ztxt):
@@ -126,7 +165,10 @@ def make_sharded_loss_fn(
         out_specs=P(),
         # The pallas interpreter (CPU tests) can't yet type varying/unvarying mixes
         # through its internal dynamic_slice; jax's own error message prescribes
-        # disabling the replication check for such bodies.
-        check_vma=not use_pallas,
+        # disabling the replication check for such bodies. The chunked scan's
+        # replicated-init f32 accumulator trips the same typing (the carry
+        # turns varying on the first add) — its grads are pinned against the
+        # checked fused path by the parity oracles instead.
+        check_vma=not (use_pallas or loss_impl == "chunked"),
     )
     return jax.jit(fn) if jit else fn

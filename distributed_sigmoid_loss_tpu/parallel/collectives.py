@@ -26,6 +26,7 @@ __all__ = [
     "ring_shift_left",
     "neighbour_exchange",
     "neighbour_exchange_bidir",
+    "double_buffered_scan",
     "pvary",
 ]
 
@@ -71,6 +72,47 @@ def neighbour_exchange(x: jax.Array, axis_name: str, *, to_right: bool = True):
     call pattern ``neighbour_exchange(left_rank, right_rank, tensor_to_right)``
     (rwightman_sigmoid_loss.py:97-99, 110-112)."""
     return ring_shift_right(x, axis_name) if to_right else ring_shift_left(x, axis_name)
+
+
+def double_buffered_scan(issue, consume, first, acc, n_hops: int):
+    """Comm/compute-overlapped ring loop: issue hop ``k+1`` BEFORE consuming
+    hop ``k``.
+
+    The serial ring (``exchange → compute → exchange → ...``) leaves every ICI
+    transfer exposed: the MXU idles while the wire moves the next chunk. This
+    carry restructure puts each iteration's ``ppermute`` and the PREVIOUS
+    hop's block matmuls in the same scan body with no data dependency between
+    them, so XLA's scheduler can run the DMA behind the matmul — the standard
+    double-buffering cure for exposed exchange latency (the reference gets the
+    same overlap from ``batch_isend_irecv`` + interleaved compute).
+
+    Args:
+      issue: ``payload -> next_payload`` — the exchange (any pytree payload;
+        the bidir ring passes the ``(from_right, from_left)`` pair).
+      consume: ``(payload, acc) -> acc`` — hop k's compute.
+      first: hop 1's payload, ALREADY issued by the caller (before its own
+        local compute, so hop 1 also overlaps).
+      n_hops: total hops to consume.
+
+    Returns ``(last_payload, acc)`` where ``last_payload`` is hop
+    ``n_hops``'s payload, NOT yet consumed — the caller folds it in the
+    epilogue, optionally issuing a final remainder exchange first. Identical
+    accumulation order to the serial loop (the adds are merely interleaved
+    with comm issue, never reordered), so results stay bitwise-comparable.
+    """
+    if n_hops < 1:
+        raise ValueError(f"n_hops must be >= 1, got {n_hops}")
+    if n_hops == 1:
+        return first, acc
+
+    def step(carry, _):
+        cur, a = carry
+        nxt = issue(cur)  # hop k+1 on the wire ...
+        a = consume(cur, a)  # ... while hop k feeds the MXU
+        return (nxt, a), None
+
+    (last, acc), _ = lax.scan(step, (first, acc), None, length=n_hops - 1)
+    return last, acc
 
 
 def neighbour_exchange_bidir(

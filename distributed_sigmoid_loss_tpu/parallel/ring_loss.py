@@ -31,6 +31,7 @@ from jax import lax
 
 from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import sigmoid_loss_block
 from distributed_sigmoid_loss_tpu.parallel.collectives import (
+    double_buffered_scan,
     neighbour_exchange,
     neighbour_exchange_bidir,
 )
@@ -48,12 +49,19 @@ def ring_sigmoid_loss(
     bidir: bool = True,
     precision=lax.Precision.HIGHEST,
     use_pallas: bool = False,
+    overlap: bool = False,
 ) -> jax.Array:
     """Per-shard loss of the ring variant; call inside ``shard_map``.
 
     Mathematically equal to :func:`allgather_sigmoid_loss` (the reference proves this
     with its variant-parity test, test_sigmoid_loss_variants.py:93-113) with a different
     communication pattern: ``W-1`` neighbor hops instead of one all-gather.
+
+    ``overlap=True`` restructures the hop loop double-buffered (hop k+1's
+    ``ppermute`` issued before hop k's block-loss matmuls — see
+    :func:`~distributed_sigmoid_loss_tpu.parallel.collectives.double_buffered_scan`)
+    so XLA can hide the ICI transfer behind the MXU. The accumulation order is
+    UNCHANGED, so the overlapped ring is bitwise-comparable to the serial one.
     """
     def block(ztxt_chunk, negative_only):
         if use_pallas:
@@ -77,10 +85,13 @@ def ring_sigmoid_loss(
             precision=precision,
         )
 
+    w = lax.axis_size(axis_name)
+    if overlap and w > 1:
+        return _ring_sigmoid_loss_overlapped(block, ztxt, axis_name, w, bidir)
+
     # Positive (own-shard) block: rwightman_sigmoid_loss.py:69.
     loss = block(ztxt, False)
 
-    w = lax.axis_size(axis_name)
     if w == 1:
         return loss
 
@@ -117,3 +128,55 @@ def ring_sigmoid_loss(
         (_, loss), _ = lax.scan(step, (ztxt, loss), None, length=w - 1)
 
     return loss
+
+
+def _ring_sigmoid_loss_overlapped(block, ztxt, axis_name: str, w: int, bidir: bool):
+    """Double-buffered hop loop: every exchange is issued BEFORE the compute it
+    could overlap with — hop 1 before the positive block, hop k+1 before hop
+    k's negative blocks, the even-W remainder hop before the last pair's
+    blocks. Hop order and accumulation order match the serial ring exactly
+    (same reference semantics, same float add sequence), so the two are
+    bitwise-comparable; only the comm/compute interleaving differs.
+    """
+    if bidir:
+        num_bidir, remainder = divmod(w - 1, 2)
+        if num_bidir == 0:
+            # w == 2: the lone unidirectional remainder hop, issued before the
+            # positive block (rwightman_sigmoid_loss.py:96-107 semantics).
+            from_left = neighbour_exchange(ztxt, axis_name, to_right=True)
+            return block(ztxt, False) + block(from_left, True)
+
+        # Pair 1 on the wire while the positive block runs.
+        first = neighbour_exchange_bidir(ztxt, ztxt, axis_name)
+        loss = block(ztxt, False)
+        (from_right, from_left), loss = double_buffered_scan(
+            lambda pair: neighbour_exchange_bidir(pair[0], pair[1], axis_name),
+            # Same accumulation order as the serial ring (from_right then
+            # from_left — the reference's recv loop, rwightman:86-93).
+            lambda pair, acc: acc + block(pair[0], True) + block(pair[1], True),
+            first,
+            loss,
+            num_bidir,
+        )
+        if remainder:
+            # Even W: issue the remainder hop BEFORE the last pair's blocks.
+            # The serial ring sends its post-scan `to_right` (= the last
+            # pair's from_left) — identical payload here.
+            last = neighbour_exchange(from_left, axis_name, to_right=True)
+        loss = loss + block(from_right, True) + block(from_left, True)
+        if remainder:
+            loss = loss + block(last, True)
+        return loss
+
+    # Unidirectional: W-1 rightward hops, hop 1 issued before the positive
+    # block (rwightman_sigmoid_loss.py:108-122 semantics).
+    first = neighbour_exchange(ztxt, axis_name, to_right=True)
+    loss = block(ztxt, False)
+    last, loss = double_buffered_scan(
+        lambda cur: neighbour_exchange(cur, axis_name, to_right=True),
+        lambda cur, acc: acc + block(cur, True),
+        first,
+        loss,
+        w - 1,
+    )
+    return loss + block(last, True)

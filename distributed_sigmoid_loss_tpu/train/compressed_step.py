@@ -253,6 +253,11 @@ def make_compressed_train_step(
         family=loss_cfg.family, variant="all_gather",
         axis_name=(dcn_axis, axis), bidir=loss_cfg.bidir,
         precision=_precision(loss_cfg.precision),
+        # Streamed negatives compose: the chunked scan runs over the joint
+        # (dcn, dp) gather's W chunks inside this already-unchecked manual
+        # region. ring_overlap is deliberately NOT threaded — this step is
+        # all-gather-only (make_per_shard_loss would refuse it anyway).
+        loss_impl=loss_cfg.loss_impl,
     )
 
     def local_loss(params, images, tokens):

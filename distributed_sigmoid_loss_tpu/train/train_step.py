@@ -540,8 +540,12 @@ def make_train_step(
     per_shard = make_per_shard_loss(
         family=loss_cfg.family, variant=loss_cfg.variant, axis_name=axis,
         bidir=loss_cfg.bidir, precision=precision,
-        use_pallas=loss_cfg.use_pallas,
+        use_pallas=loss_cfg.use_pallas, loss_impl=loss_cfg.loss_impl,
+        ring_overlap=loss_cfg.ring_overlap,
     )
+    # See parallel/api.py: the pallas interpreter and the chunked scan's
+    # replicated-init carry both need the replication check off.
+    loss_check_vma = not (loss_cfg.use_pallas or loss_cfg.loss_impl == "chunked")
 
     # Embeddings enter the loss island sharded over dp, replicated over other axes.
     emb_spec = P(axis)
@@ -554,9 +558,13 @@ def make_train_step(
         mesh=mesh,
         in_specs=(emb_spec, emb_spec, P(), P()),
         out_specs=P(),
-        # See parallel/api.py: the pallas interpreter needs the replication check off.
-        check_vma=not loss_cfg.use_pallas,
+        check_vma=loss_check_vma,
     )
+    if loss_cfg.loss_impl == "chunked":
+        # Grads of the chunk scan must flow through a JITTED shard_map: the
+        # 0.4.x eager/inline transpose cannot type the scan's scalar carry
+        # (_jax_compat target). jit-in-jit is a free pjit inline on >= 0.6.
+        sharded_loss = jax.jit(sharded_loss)
 
     if accum_negatives not in ("local", "global"):
         raise ValueError(
@@ -653,8 +661,10 @@ def make_train_step(
         mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(), P()),
         out_specs=P(),
-        check_vma=not loss_cfg.use_pallas,
+        check_vma=loss_check_vma,
     )
+    if loss_cfg.loss_impl == "chunked":
+        stacked_loss = jax.jit(stacked_loss)  # same 0.4.x transpose contract
 
     def grads_and_metrics_cached(params, batch):
         from distributed_sigmoid_loss_tpu.parallel.microbatch import (

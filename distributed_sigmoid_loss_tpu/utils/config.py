@@ -28,6 +28,16 @@ class LossConfig:
     precision: str = "highest"
     # Fused Pallas loss kernel (falls back to XLA for non-tileable shapes).
     use_pallas: bool = False
+    # "chunked" (all_gather sigmoid only): stream the gathered negatives
+    # through a lax.scan over W chunk-blocks instead of one fused
+    # (local_b, W*local_b) matmul — the full logits matrix is never
+    # materialized, cutting peak loss HBM ~W* (ops/sigmoid_loss.py
+    # sigmoid_loss_chunk_scan). Parity-oracled against "fused".
+    loss_impl: Literal["fused", "chunked"] = "fused"
+    # Ring sigmoid only: double-buffer the hop loop (hop k+1's ppermute issued
+    # before hop k's block matmuls) so XLA hides ICI latency behind the MXU.
+    # Bitwise-comparable to the serial ring (same accumulation order).
+    ring_overlap: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

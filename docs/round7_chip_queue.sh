@@ -1,0 +1,70 @@
+#!/bin/bash
+# Round-7 chip measurement queue — streamed negatives + overlapped ring A/Bs:
+#   nohup bash docs/round7_chip_queue.sh > /tmp/r7queue.log 2>&1 &
+#
+# Same recovery-waiting discipline as rounds 5-6: one bounded probe per cycle
+# until the tunnel answers, then measurements cheapest-first. NEVER signal a
+# running bench process (SIGTERM mid-XLA-compile wedges the tunnel —
+# docs/PERF.md postmortems). --loss-impl chunked and --ring-overlap are both
+# fresh-compile configs, so bench.py runs every A/B below under the detached
+# compile shield automatically (tests/test_bench_shield.py pins that).
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-6 queue.
+while pgrep -f round6_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+set -x
+# 1. bf16 headline + 32k-equiv (cached compiles) — the anchor every A/B
+#    below is read against, banked first.
+python bench.py
+# 2. OVERLAPPED RING at the headline recipe: same math bitwise, hop k+1's
+#    ppermute hidden behind hop k's MXU matmuls. On 1 chip this prices the
+#    restructured scan's overhead (should be a wash); the ICI win needs the
+#    v5e-8 — run there when the pod window opens.
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --ring-overlap --metric-suffix _ringov
+# 3. FUSED ALL-GATHER anchor at the same recipe (the chunked comparison needs
+#    a same-variant baseline; the headline is ring).
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --metric-suffix _ag
+# 4. CHUNKED (streamed negatives) vs 3: same shapes, the (local_b, W*local_b)
+#    logits never materialized. Watch peak_hbm_gb in the records — the CPU
+#    regression test pins temp bytes at 0.25x fused for the loss island; the
+#    step-level delta on chip is the honest number.
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --loss-impl chunked \
+  --metric-suffix _chunked
+# 5. THE POINT of chunked: push per-chip batch past where the fused loss
+#    OOMs. 6144/chip = 48 microbatches of 128 — the loss-memory headroom
+#    bought by streaming, spent on batch.
+python bench.py 6144 5 b16 --accum 48 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --loss-impl chunked \
+  --metric-suffix _chunked_6k
+# 6. 32K-EQUIV with the overlapped ring: the north-star per-chip shape
+#    (4096/chip = 32 microbatches of 128) on the restructured hop loop.
+python bench.py 4096 5 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --ring-overlap --metric-suffix _ringov_32k_equiv
+# 7. Loss-island attribution for the two new paths (fresh-compile, shielded):
+#    the loss_island_ms row vs the round-4 bf16 table isolates the chunk
+#    scan's compute tax and the overlap restructure's scheduling delta.
+python bench.py 288 10 b16 --step-breakdown --variant all_gather \
+  --loss-impl chunked
+python bench.py 288 10 b16 --step-breakdown --ring-overlap

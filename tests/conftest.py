@@ -54,6 +54,7 @@ _STANDARD_MODULES = {
     "test_distributed_parity",
     "test_pipeline",
     "test_serve",
+    "test_streamed_loss",
     "test_torch_reference_parity",
 }
 

@@ -112,6 +112,7 @@ def _bench_args(**overrides):
         step_breakdown=False, moe_breakdown=False, moe=0, context=0,
         attn_impl="auto", text_attn_impl="", attn_bwd="loop",
         accum_negatives="local", gradcache_bf16=False, quant_train="",
+        loss_impl="fused", ring_overlap=False,
     )
     defaults.update(overrides)
     return argparse.Namespace(**defaults)
@@ -128,6 +129,20 @@ def test_fresh_compile_config_covers_gradcache_variants():
     # The pre-existing triggers still hold.
     assert bench._fresh_compile_config(_bench_args(attn_impl="dense"))
     assert bench._fresh_compile_config(_bench_args(attn_bwd="batched"))
+
+
+def test_fresh_compile_config_covers_streamed_loss_and_overlap():
+    """Round-7: the chunked all-gather loss and the overlapped ring both
+    rebuild the loss island's program (chunk scan / double-buffered hop
+    loop) — neither sits in the warm cache of routine headline runs, so the
+    A/Bs queued in docs/round7_chip_queue.sh must run under the compile
+    shield (a hung fresh-compile A/B must never eat the headline record)."""
+    bench = _bench_module()
+    assert bench._fresh_compile_config(_bench_args(loss_impl="chunked"))
+    assert bench._fresh_compile_config(_bench_args(ring_overlap=True))
+    assert not bench._fresh_compile_config(
+        _bench_args(loss_impl="fused", ring_overlap=False)
+    )
 
 
 def test_fresh_compile_config_covers_quant_train():

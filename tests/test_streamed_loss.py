@@ -1,0 +1,272 @@
+"""Streamed negatives + overlapped ring: the round-7 loss memory/latency paths.
+
+Two optimizations, two oracles:
+
+1. ``loss_impl="chunked"`` (parallel/allgather_loss.py + ops/sigmoid_loss.py
+   ``sigmoid_loss_chunk_scan``) streams the gathered negatives through a
+   ``lax.scan`` over W chunk-blocks so the ``(local_b, W·local_b)`` logits are
+   never materialized. Oracle: loss AND ``jax.grad`` parity vs the fused
+   matmul path (rtol ≤ 1e-4 f32, bf16-grade for bf16 embeddings) across world
+   sizes incl. odd W, plus a compiled peak-memory regression — XLA's own
+   ``memory_analysis()`` must show the chunked program's temp bytes a fraction
+   of the fused program's at W=8 (CPU-assertable; utils/profiling.py helper).
+
+2. ``ring_overlap=True`` (parallel/ring_loss.py + collectives.py
+   ``double_buffered_scan``) issues hop k+1's ppermute before hop k's block
+   matmuls. The accumulation order is untouched, so the oracle is BITWISE
+   loss equality with the serial ring (grads at rtol 1e-6) on the same sweep
+   (even-W remainder hop and the unidir branch included).
+
+The standard tier runs a W-subset covering every structural case (W=1, the
+even-W remainder hop, odd W, paired-only W, the 8-device max); the exhaustive
+W∈{1..8} × dtype × bidir sweep is slow-tier (ROADMAP --durations=15 rule).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_sigmoid_loss_tpu.ops.sigmoid_loss import (
+    init_loss_params,
+    l2_normalize,
+)
+from distributed_sigmoid_loss_tpu.parallel import make_mesh, make_sharded_loss_fn
+
+RTOL_F32 = 1e-5  # comfortably inside the build target rtol<1e-4
+RTOL_BF16 = 3e-2  # per-block sums carry bf16 input rounding (~2^-9 relative)
+
+
+def make_batch(global_b, d, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    zi = l2_normalize(jnp.asarray(rng.standard_normal((global_b, d)), jnp.float32))
+    zt = l2_normalize(jnp.asarray(rng.standard_normal((global_b, d)), jnp.float32))
+    return zi.astype(dtype), zt.astype(dtype)
+
+
+def loss_and_grads(fn, params, zi, zt):
+    return jax.value_and_grad(fn, argnums=(0, 1, 2))(params, zi, zt)
+
+
+def assert_chunked_matches_fused(w, dtype, rtol, atol, global_b=None, d=16):
+    mesh = make_mesh(w)
+    fused = make_sharded_loss_fn(mesh, variant="all_gather")
+    chunked = make_sharded_loss_fn(mesh, variant="all_gather", loss_impl="chunked")
+    zi, zt = make_batch(global_b or 2 * w, d, dtype=dtype)
+    params = init_loss_params()
+    lf, gf = loss_and_grads(fused, params, zi, zt)
+    lc, gc = loss_and_grads(chunked, params, zi, zt)
+    np.testing.assert_allclose(
+        np.asarray(lf, np.float32), np.asarray(lc, np.float32), rtol=rtol
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=rtol, atol=atol,
+        ),
+        gf, gc,
+    )
+
+
+def assert_overlap_matches_serial(w, bidir, dtype=jnp.float32):
+    mesh = make_mesh(w)
+    serial = make_sharded_loss_fn(mesh, variant="ring", bidir=bidir)
+    overlap = make_sharded_loss_fn(
+        mesh, variant="ring", bidir=bidir, ring_overlap=True
+    )
+    zi, zt = make_batch(2 * w, 16, seed=3, dtype=dtype)
+    params = init_loss_params()
+    ls, gs = loss_and_grads(serial, params, zi, zt)
+    lo, go = loss_and_grads(overlap, params, zi, zt)
+    # Same float add sequence -> the loss is bitwise-equal, not merely close.
+    np.testing.assert_array_equal(np.asarray(ls), np.asarray(lo))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        gs, go,
+    )
+
+
+# W subset covering every structural case: 1 (no comm), 2 (bidir = lone
+# remainder hop), 3 (paired hops only), 5 (scan length > 1), 8 (even-W
+# remainder AFTER paired hops, the full mesh).
+@pytest.mark.parametrize("world_size", [1, 2, 3, 5, 8])
+def test_chunked_matches_fused_f32(world_size):
+    assert_chunked_matches_fused(world_size, jnp.float32, RTOL_F32, 1e-6)
+
+
+@pytest.mark.parametrize("world_size", [3, 8])
+def test_chunked_matches_fused_bf16(world_size):
+    assert_chunked_matches_fused(world_size, jnp.bfloat16, RTOL_BF16, 1e-2)
+
+
+def test_chunked_matches_fused_uneven_shapes():
+    """local_b > 2 and a non-power-of-two d: the chunk layout must not depend
+    on tidy shapes."""
+    assert_chunked_matches_fused(4, jnp.float32, RTOL_F32, 1e-6, global_b=12, d=24)
+
+
+@pytest.mark.parametrize("world_size", [1, 2, 3, 5, 8])
+def test_overlapped_ring_matches_serial_bidir(world_size):
+    assert_overlap_matches_serial(world_size, bidir=True)
+
+
+@pytest.mark.parametrize("world_size", [2, 5])
+def test_overlapped_ring_matches_serial_unidir(world_size):
+    assert_overlap_matches_serial(world_size, bidir=False)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("world_size", list(range(1, 9)))
+def test_chunked_and_overlap_exhaustive(world_size):
+    """The full acceptance sweep: W∈{1..8}, f32 + bf16 chunked parity and both
+    ring directions overlapped — the standard tier covers the structural
+    subset; this pins every remaining W."""
+    assert_chunked_matches_fused(world_size, jnp.float32, RTOL_F32, 1e-6)
+    assert_chunked_matches_fused(world_size, jnp.bfloat16, RTOL_BF16, 1e-2)
+    for bidir in (True, False):
+        assert_overlap_matches_serial(world_size, bidir)
+
+
+def test_chunked_compiles_to_lower_peak_memory_at_w8():
+    """THE memory claim, regression-tested: at W=8 the chunked loss's compiled
+    temp bytes (and the peak-bytes sum) must be a small fraction of the fused
+    path's — XLA's own static accounting via utils/profiling.py, no chip
+    needed. Measured at introduction: temp ratio 0.25, peak ratio 0.28."""
+    from distributed_sigmoid_loss_tpu.utils.profiling import compiled_memory_stats
+
+    mesh = make_mesh(8)
+    local_b, d = 128, 32
+    zi, zt = make_batch(8 * local_b, d, seed=1)
+    params = init_loss_params()
+
+    def stats(impl):
+        fn = make_sharded_loss_fn(
+            mesh, variant="all_gather", loss_impl=impl, jit=False
+        )
+        # Grad through the jitted fn: the 0.4.x eager shard_map transpose
+        # can't type the scan carry, and the real train step is jitted anyway.
+        jfn = jax.jit(fn)
+
+        def value_and_grads(p, a, b):
+            return jax.value_and_grad(jfn, argnums=(0, 1, 2))(p, a, b)
+
+        m = compiled_memory_stats(value_and_grads, params, zi, zt)
+        assert m is not None, "memory_analysis unavailable on this backend"
+        return m
+
+    fused, chunked = stats("fused"), stats("chunked")
+    assert fused["temp_size_in_bytes"] > 0
+    temp_ratio = chunked["temp_size_in_bytes"] / fused["temp_size_in_bytes"]
+    peak_ratio = chunked["peak_bytes"] / fused["peak_bytes"]
+    assert temp_ratio < 0.5, (
+        f"chunked loss should compile to a fraction of the fused temp bytes "
+        f"at W=8, got ratio {temp_ratio:.3f} "
+        f"({chunked['temp_size_in_bytes']} vs {fused['temp_size_in_bytes']})"
+    )
+    assert peak_ratio < 0.6, f"peak-bytes ratio regressed: {peak_ratio:.3f}"
+
+
+def test_memory_helper_basic_contract():
+    """compiled_memory_stats on a trivial jitted fn: all fields present,
+    peak = arg + out + temp + codegen - alias."""
+    from distributed_sigmoid_loss_tpu.utils.profiling import compiled_memory_stats
+
+    m = compiled_memory_stats(lambda x: (x @ x.T).sum(), jnp.ones((64, 64)))
+    assert m is not None
+    assert m["argument_size_in_bytes"] == 64 * 64 * 4
+    assert m["temp_size_in_bytes"] > 0
+    assert m["peak_bytes"] == (
+        m["argument_size_in_bytes"] + m["output_size_in_bytes"]
+        + m["temp_size_in_bytes"] + m["generated_code_size_in_bytes"]
+        - m["alias_size_in_bytes"]
+    )
+
+
+def test_flag_conflicts_refused():
+    """make_per_shard_loss refuses every flag/variant mismatch at build time —
+    a run claiming a memory/overlap recipe that never executed is config
+    drift, not a default."""
+    from distributed_sigmoid_loss_tpu.parallel.api import make_per_shard_loss
+
+    with pytest.raises(ValueError, match="all-gather variant only"):
+        make_per_shard_loss(variant="ring", loss_impl="chunked")
+    with pytest.raises(ValueError, match="ring variant only"):
+        make_per_shard_loss(variant="all_gather", ring_overlap=True)
+    with pytest.raises(ValueError, match="sigmoid family only"):
+        make_per_shard_loss(family="softmax", loss_impl="chunked")
+    with pytest.raises(ValueError, match="sigmoid family only"):
+        make_per_shard_loss(family="softmax", variant="ring", ring_overlap=True)
+    with pytest.raises(ValueError, match="pick one"):
+        make_per_shard_loss(
+            variant="all_gather", loss_impl="chunked", use_pallas=True
+        )
+    with pytest.raises(ValueError, match="unknown loss_impl"):
+        make_per_shard_loss(variant="all_gather", loss_impl="streamed")
+
+
+def test_cli_flag_conflicts_exit_2():
+    """The train CLI surfaces the same conflicts as exit-2 usage errors before
+    any state init."""
+    from distributed_sigmoid_loss_tpu.cli import main
+
+    base = ["train", "--tiny", "--steps", "1"]
+    assert main(base + ["--variant", "ring", "--loss-impl", "chunked"]) == 2
+    assert main(base + ["--variant", "all_gather", "--ring-overlap"]) == 2
+    assert main(base + ["--loss-impl", "chunked", "--ring-overlap"]) == 2
+    assert main(
+        base + ["--loss-family", "softmax", "--loss-impl", "chunked"]
+    ) == 2
+    assert main(base + ["--ring-overlap", "--grad-compression", "int8"]) == 2
+
+
+@pytest.mark.slow
+def test_train_step_chunked_and_overlap_match_baselines():
+    """End-to-end wiring: one tiny train step per new path produces the same
+    loss metric as its baseline counterpart (same init, same batch — the loss
+    value is computed before the update, so parity is exact to loss-impl
+    rounding)."""
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import (
+        LossConfig,
+        SigLIPConfig,
+        TrainConfig,
+    )
+
+    mesh = make_mesh(8)
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": jnp.asarray(
+            rng.standard_normal(
+                (16, cfg.vision.image_size, cfg.vision.image_size, 3)
+            ),
+            jnp.float32,
+        ),
+        "tokens": jnp.asarray(
+            rng.integers(0, cfg.text.vocab_size, (16, cfg.text.context_length)),
+            jnp.int32,
+        ),
+    }
+
+    def one_step(loss_cfg):
+        state = create_train_state(jax.random.key(0), model, tx, batch, mesh)
+        step, shardings = make_train_step(model, mesh, loss_cfg)
+        _, metrics = step(state, jax.device_put(batch, shardings))
+        return float(metrics["loss"])
+
+    fused = one_step(LossConfig(variant="all_gather"))
+    chunked = one_step(LossConfig(variant="all_gather", loss_impl="chunked"))
+    np.testing.assert_allclose(chunked, fused, rtol=1e-5)
+
+    serial = one_step(LossConfig(variant="ring"))
+    overlapped = one_step(LossConfig(variant="ring", ring_overlap=True))
+    np.testing.assert_allclose(overlapped, serial, rtol=1e-6)
