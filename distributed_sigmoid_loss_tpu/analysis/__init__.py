@@ -1,0 +1,64 @@
+"""graftlint: static analyzers for the distributed-correctness bug classes
+this repo has actually hit.
+
+Two halves, one Finding stream:
+
+- :mod:`.jaxpr_audit` traces the real loss/train-step builders on the
+  virtual-device CPU mesh and walks their closed jaxprs (collective axis
+  binding, ppermute bijections, S-fold psum overcounts, dtype/weak-type
+  hygiene, the chunked scan's checkpoint contract). Trace-only — no compile.
+- :mod:`.repo_lint` is an AST pass over the package + bench.py enforcing
+  repo invariants (trace-time mutable globals, bench compile-shield
+  coverage, doc staleness, slow markers, bench record schema).
+
+Run via ``python -m distributed_sigmoid_loss_tpu lint`` (exit 1 on findings,
+``--json``, per-rule ``--disable``), via the dryrun's graftlint token
+(__graft_entry__.py), and via tests/test_analysis.py so the gate is
+self-enforcing on every future PR. Rule catalog + allowlist policy:
+docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from distributed_sigmoid_loss_tpu.analysis.findings import Finding  # noqa: F401
+from distributed_sigmoid_loss_tpu.analysis.repo_lint import (  # noqa: F401
+    REPO_RULES,
+    run_repo_lint,
+)
+
+__all__ = ["Finding", "ALL_RULES", "REPO_RULES", "JAXPR_RULES", "run_lint"]
+
+# jaxpr rule ids duplicated here (not imported) so listing rules — the CLI's
+# --disable choices — never pays the jax import.
+JAXPR_RULES = (
+    "jaxpr-ppermute-bijection",
+    "jaxpr-collective-axis",
+    "jaxpr-double-psum",
+    "jaxpr-f64",
+    "jaxpr-weak-type",
+    "jaxpr-chunk-checkpoint",
+    "jaxpr-bf16-upcast",
+)
+
+ALL_RULES = REPO_RULES + JAXPR_RULES
+
+
+def run_lint(
+    disabled=(), jaxpr: bool = True, n_devices: int | None = None,
+) -> list[Finding]:
+    """Run the repo linter and (unless ``jaxpr=False``) the jaxpr auditor.
+
+    ``disabled``: rule ids to drop from the result. ``n_devices``: virtual
+    mesh size for the auditor (default: min(8, available)).
+    """
+    disabled = set(disabled)
+    findings = run_repo_lint(disabled=disabled)
+    if jaxpr:
+        # Imported lazily: the AST half must stay usable (and fast) in
+        # processes that never initialize jax.
+        from distributed_sigmoid_loss_tpu.analysis.jaxpr_audit import (
+            audit_default_step_configs,
+        )
+
+        findings.extend(audit_default_step_configs(n_devices=n_devices))
+    return [f for f in findings if f.rule not in disabled]

@@ -1,0 +1,91 @@
+"""THE declared schema for bench.py's JSON record fields.
+
+Every bench mode (train headline, eval-throughput, context, step/MoE
+breakdowns, backend-error and shield-deferral records) emits one-line JSON
+records that downstream per-metric streams parse. Before this schema each
+emit path grew fields independently, so a new config knob (quant_train,
+loss_impl, ring_overlap, ...) could land in one path and silently drift from
+the others — the exact per-path divergence the bench shield's ADVICE round-5
+findings came from.
+
+One registry, three consumers:
+
+- ``bench.py`` routes every record through ``_emit`` → :func:`validate_record`
+  (stderr warning on violation; the record still prints — a measurement must
+  never be lost to its own validator).
+- ``tests/test_bench_shield.py`` / ``tests/test_analysis.py`` assert example
+  records from each emit path validate.
+- ``analysis/repo_lint.py`` statically cross-checks every record-field string
+  literal in bench.py against this registry (rule ``repo-bench-record``), so
+  an unregistered field fails tier-1 before it ever runs on a chip.
+
+Stdlib-only module: bench.py's top-level imports must not initialize jax.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "REQUIRED_RECORD_FIELDS",
+    "BENCH_RECORD_FIELDS",
+    "validate_record",
+]
+
+# Present in EVERY record, including error/deferral stubs: the driver's
+# one-JSON-line contract keys streams by `metric` and plots `value`/`unit`.
+REQUIRED_RECORD_FIELDS = ("metric", "value", "unit")
+
+# The full registered field set, grouped by the emit path that owns them.
+# Adding a record field to bench.py without registering it here fails the
+# repo-bench-record lint rule (and the schema tests).
+BENCH_RECORD_FIELDS = frozenset(
+    REQUIRED_RECORD_FIELDS
+    + (
+        # shared across modes
+        "vs_baseline", "model", "steps", "device_kind", "error",
+        # train headline
+        "a100_ref_pairs_per_sec", "per_chip_batch", "global_batch",
+        "accum_steps", "accum_negatives", "steps_per_call", "variant",
+        "loss_family", "precision", "use_pallas", "remat_policy",
+        "n_devices", "final_loss", "model_tflops_per_sec_per_chip",
+        "peak_hbm_gb", "peak_hbm_live_gb", "scan_layers", "attn_impl",
+        "text_attn_impl", "attn_bwd", "attn_bwd_argv", "attn_bwd_mismatch",
+        "attn_bwd_traced", "moe_experts", "moe_num_selected",
+        "moe_group_size", "moe_capacity_factor", "quant_train", "loss_impl",
+        "ring_overlap", "zero1", "adam_mu_dtype", "accum_dtype",
+        "gradcache_embed_dtype", "no_text_remat",
+        "hw_tflops_per_sec_per_chip", "mfu", "hw_util",
+        # eval-throughput
+        "batch", "quant", "fwd_tflops_per_sec_per_chip", "mfu_bf16_basis",
+        # context bench
+        "context", "width", "num_heads", "impls",
+        # step breakdown
+        "parts",
+        # moe breakdown
+        "dense_mlp_ms", "stages", "tokens", "experts", "num_selected",
+        "group", "capacity",
+        # shield deferral records
+        "deferred", "signal", "child_pid", "child_stdout", "child_stderr",
+    )
+)
+
+
+def validate_record(record) -> list[str]:
+    """Validate one bench JSON record against the declared schema.
+
+    Returns a list of problem strings (empty = valid). Field VALUES are not
+    typed here — the schema pins the field NAMESPACE, which is what drifts.
+    """
+    if not isinstance(record, dict):
+        return [f"record must be a dict, got {type(record).__name__}"]
+    problems = []
+    for field in REQUIRED_RECORD_FIELDS:
+        if field not in record:
+            problems.append(f"missing required field {field!r}")
+    unknown = sorted(set(record) - BENCH_RECORD_FIELDS)
+    if unknown:
+        problems.append(
+            "unregistered field(s) "
+            + ", ".join(repr(u) for u in unknown)
+            + " — register in analysis/bench_schema.py BENCH_RECORD_FIELDS"
+        )
+    return problems

@@ -1,0 +1,33 @@
+"""The one Finding type every graftlint rule reports through.
+
+Stdlib-only on purpose: ``bench_schema`` (imported by bench.py, whose
+top-level imports must stay stdlib-only) and the AST linter share it without
+pulling jax into processes that never trace anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["Finding"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint/audit finding.
+
+    ``rule``: the rule id (stable, used by ``lint --disable``).
+    ``subject``: what was audited — a step-config label for jaxpr rules, a
+    ``path::name`` for repo rules.
+    ``detail``: human-readable description of the violation and why it bites.
+    """
+
+    rule: str
+    subject: str
+    detail: str
+
+    def __str__(self) -> str:  # the `lint` CLI's text output line
+        return f"[{self.rule}] {self.subject}: {self.detail}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)

@@ -1,0 +1,722 @@
+"""graftlint's jaxpr half: static audit of the distributed loss/train-step
+programs' communication structure and dtype hygiene.
+
+Every distributed-correctness bug this repo hit was statically visible in the
+jaxpr before a single device cycle: a broken ring permutation silently
+zero-fills the shards nobody sends to; a psum of an already-reduced
+(axis-invariant) value overcounts S-fold in an unchecked shard_map transpose;
+a python-scalar input leaks a weak-typed aval and recompiles per call-site
+flavor; dropping the chunk scan's ``jax.checkpoint`` silently re-materializes
+the full logits matrix in the backward. This auditor traces the REAL step
+builders (make_train_step / make_compressed_train_step) on the virtual-device
+CPU mesh — trace only, no compile, no execution — and walks the closed
+jaxprs. The "verify the sharded program's communication structure, don't
+trust the author" discipline of XLA's cross-replica sharding work (Xu et al.,
+arXiv:2004.13336) applied to this repo's own programs.
+
+Rules (ids used by ``lint --disable`` and the Finding records):
+
+- ``jaxpr-ppermute-bijection``: every ppermute perm is a total bijection on a
+  live mesh axis (shared check with parallel/collectives.validate_ring_perm).
+- ``jaxpr-collective-axis``: every named-axis collective names axes actually
+  bound by an enclosing shard_map.
+- ``jaxpr-double-psum``: no value reduced TWICE over the same axis along one
+  path (the S-fold overcount class). Two taints ride the dataflow: axes a
+  value is *invariant* (replicated) over, and axes it was already
+  *reduced/gathered* over. Only a psum/psum_scatter of a still-reduced value
+  trips the rule: jax's own psum-self-transpose convention (the pmean
+  backward psums a replicated cotangent, exactly compensated by the 1/S)
+  consumes values that are replicated but NOT reduced, so it stays silent —
+  as do psums of literals (the symbolic-zero transpose artifact and the
+  ``psum(1)`` axis-size idiom). Mixing a reduced value with varying data
+  clears the taint (a later psum is then a genuine new reduction);
+  unknown ⇒ varying ⇒ silent, the no-false-positive direction.
+- ``jaxpr-f64``: no float64/complex128 avals anywhere (silent x64 promotion).
+- ``jaxpr-weak-type``: no weak-typed input avals (python-scalar leak — the
+  recompile-per-callsite hazard).
+- ``jaxpr-chunk-checkpoint``: the chunked loss's scan carries a
+  ``jax.checkpoint``'d body (remat eqn inside a dot-bearing scan) — pins
+  PR 3's memory contract structurally, complementing the byte-count
+  regression test in tests/test_streamed_loss.py.
+- ``jaxpr-bf16-upcast``: (opt-in, ``check_bf16_upcast=True``) no explicit
+  bf16→f32 convert feeding a dot_general inside a declared-bf16 region — the
+  silent half-MXU-rate upcast; f32 ACCUMULATION via
+  ``preferred_element_type`` is the sanctioned pattern and does not trip it.
+"""
+
+from __future__ import annotations
+
+from distributed_sigmoid_loss_tpu.analysis.findings import Finding
+
+__all__ = [
+    "JAXPR_RULES",
+    "audit_jaxpr",
+    "step_config_jaxprs",
+    "audit_default_step_configs",
+    "DEFAULT_STEP_CONFIGS",
+]
+
+JAXPR_RULES = (
+    "jaxpr-ppermute-bijection",
+    "jaxpr-collective-axis",
+    "jaxpr-double-psum",
+    "jaxpr-f64",
+    "jaxpr-weak-type",
+    "jaxpr-chunk-checkpoint",
+    "jaxpr-bf16-upcast",
+)
+
+# The six step configs the acceptance gate requires coverage of; see
+# step_config_jaxprs for how each is built.
+DEFAULT_STEP_CONFIGS = (
+    "fused",
+    "chunked",
+    "ring",
+    "ring_overlap",
+    "compressed_dcn",
+    "quant_train_int8",
+)
+
+# Collectives that SUM over their named axes: a second application over the
+# same axis to an already-invariant value is the S-fold overcount.
+_SUM_PRIMS = {"psum", "reduce_scatter"}
+# Reductions whose repeat is idempotent (max of replicated = same value) —
+# still tracked for axis binding, never for double-reduce.
+_IDEMPOTENT_REDUCE_PRIMS = {"pmin", "pmax"}
+_GATHER_PRIMS = {"all_gather"}
+_OTHER_COLLECTIVES = {"ppermute", "all_to_all", "pgather", "pbroadcast"}
+_ALL_COLLECTIVES = (
+    _SUM_PRIMS | _IDEMPOTENT_REDUCE_PRIMS | _GATHER_PRIMS | _OTHER_COLLECTIVES
+    | {"axis_index"}
+)
+
+_REMAT_PRIMS = {"remat2", "remat", "checkpoint"}
+
+# (invariant-over, reduced-over) for a value we know nothing about.
+_VARYING = (frozenset(), frozenset())
+
+
+def _collective_axes(eqn) -> tuple:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name"))
+    if axes is None:
+        return ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    flat = []
+    for a in axes:
+        if isinstance(a, (tuple, list)):
+            flat.extend(a)
+        else:
+            flat.append(a)
+    # positional (int) axes come from vmap, not meshes — not our concern
+    return tuple(a for a in flat if isinstance(a, str))
+
+
+def _jaxpr_of(obj):
+    """Open jaxpr of a Jaxpr/ClosedJaxpr, else None."""
+    if hasattr(obj, "eqns") and hasattr(obj, "invars"):
+        return obj
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    return None
+
+
+def _sub_jaxprs(params: dict):
+    """Every (param_key, open_jaxpr) nested in an eqn's params."""
+    out = []
+    for k, v in params.items():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for u in vals:
+            j = _jaxpr_of(u)
+            if j is not None:
+                out.append((k, j))
+    return out
+
+
+def _is_literal(v) -> bool:
+    # core.Literal has a `val`; Vars do not.
+    return hasattr(v, "val") and not hasattr(v, "count")
+
+
+class _Auditor:
+    """One audit pass over a closed jaxpr; collects deduplicated Findings."""
+
+    def __init__(self, label: str, check_bf16_upcast: bool = False):
+        self.label = label
+        self.check_bf16_upcast = check_bf16_upcast
+        self.findings: list[Finding] = []
+        self._seen: set = set()
+
+    def add(self, rule: str, detail: str) -> None:
+        key = (rule, detail)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.findings.append(Finding(rule, self.label, detail))
+
+    # -- invariance/reduction-tracking walk ---------------------------------
+
+    def walk(self, jaxpr, env: dict, bound: dict, emit: bool) -> dict:
+        """Walk one open jaxpr.
+
+        ``env``: var -> ``(inv, red)`` pair of frozensets: the mesh axes the
+        value is known INVARIANT over (replicated; identical on every shard),
+        and the subset of those it is invariant over BECAUSE it was already
+        reduced/gathered over them (the double-psum taint; always
+        ``red ⊆ inv``). Unknown vars default to varying ``(∅, ∅)`` — the
+        conservative direction: it can only suppress a finding, never
+        fabricate one. Returns the env (callers map outvars through it).
+        """
+
+        def get(v):
+            if _is_literal(v):
+                return (frozenset(bound), frozenset())
+            return env.get(v, _VARYING)
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+
+            if emit:
+                for ov in eqn.outvars:
+                    aval = getattr(ov, "aval", None)
+                    dt = getattr(aval, "dtype", None)
+                    if dt is not None and str(dt) in ("float64", "complex128"):
+                        self.add(
+                            "jaxpr-f64",
+                            f"{name} produces a {dt} value — silent f64 "
+                            "promotion (x64 leak); TPU executes f64 in "
+                            "software emulation and parity gates assume f32",
+                        )
+
+            if name == "shard_map":
+                self._walk_shard_map(eqn, env, bound, emit, get)
+                continue
+
+            if name in _ALL_COLLECTIVES:
+                self._walk_collective(eqn, env, bound, emit, get)
+                continue
+
+            if name == "scan":
+                self._walk_scan(eqn, env, bound, emit, get)
+                continue
+
+            if name == "cond":
+                self._walk_cond(eqn, env, bound, emit, get)
+                continue
+
+            subs = _sub_jaxprs(eqn.params)
+            if subs:
+                if name == "while":
+                    # Loop-carried invariance needs a fixpoint; assume varying
+                    # everywhere inside (silent, never wrong).
+                    for _, inner in subs:
+                        self.walk(inner, {}, bound, emit)
+                    for ov in eqn.outvars:
+                        env[ov] = _VARYING
+                else:
+                    # Call-like eqns (pjit, remat2, custom_jvp/vjp, ...): map
+                    # operands through positionally when the arity matches.
+                    self._walk_call(eqn, subs, env, bound, emit, get)
+                continue
+
+            # Default: elementwise/structural op — invariance is preserved
+            # only when EVERY operand is invariant over the axis; the
+            # reduced taint survives only while the value stays invariant
+            # (mixing with varying data makes a later psum a NEW reduction).
+            inv, red = None, frozenset()
+            for v in eqn.invars:
+                ii, rr = get(v)
+                inv = ii if inv is None else (inv & ii)
+                red = red | rr
+            if inv is None:
+                inv = frozenset(bound)  # no operands (iota, rng seeds, ...)
+            for ov in eqn.outvars:
+                env[ov] = (inv, red & inv)
+
+        if self.check_bf16_upcast and emit:
+            self._check_bf16_upcasts(jaxpr)
+        return env
+
+    def _walk_shard_map(self, eqn, env, bound, emit, get) -> None:
+        mesh = eqn.params.get("mesh")
+        auto = eqn.params.get("auto") or frozenset()
+        try:
+            mesh_axes = dict(mesh.shape)
+        except Exception:
+            mesh_axes = {}
+        inner_bound = dict(bound)
+        inner_bound.update(
+            {ax: sz for ax, sz in mesh_axes.items() if ax not in auto}
+        )
+        inner = _jaxpr_of(eqn.params.get("jaxpr"))
+        if inner is None:
+            for ov in eqn.outvars:
+                env[ov] = _VARYING
+            return
+        in_names = eqn.params.get("in_names") or ()
+        inner_env: dict = {}
+        for i, iv in enumerate(inner.invars):
+            sharded_over: set = set()
+            if i < len(in_names):
+                for axes_tuple in in_names[i].values():
+                    sharded_over.update(axes_tuple)
+            # A P()-replicated input is invariant over every bound axis; a
+            # P("dp")-sharded one varies over dp. Neither is REDUCED yet.
+            inner_env[iv] = (
+                frozenset(ax for ax in inner_bound if ax not in sharded_over),
+                frozenset(),
+            )
+        for cv in getattr(inner, "constvars", ()):
+            inner_env[cv] = (frozenset(inner_bound), frozenset())
+        self.walk(inner, inner_env, inner_bound, emit)
+        for ov in eqn.outvars:
+            env[ov] = _VARYING
+
+    def _walk_collective(self, eqn, env, bound, emit, get) -> None:
+        name = eqn.primitive.name
+        axes = _collective_axes(eqn)
+        if emit:
+            for ax in axes:
+                if ax not in bound:
+                    self.add(
+                        "jaxpr-collective-axis",
+                        f"{name} over axis {ax!r} which no enclosing "
+                        f"shard_map binds (bound: {sorted(bound) or 'none'})"
+                        " — the collective would resolve against a stale or "
+                        "foreign axis environment",
+                    )
+        if name == "ppermute" and emit and axes:
+            size = bound.get(axes[0])
+            if size is not None:
+                from distributed_sigmoid_loss_tpu.parallel.collectives import (
+                    ring_perm_problems,
+                )
+
+                for problem in ring_perm_problems(
+                    eqn.params.get("perm", ()), size
+                ):
+                    self.add(
+                        "jaxpr-ppermute-bijection",
+                        f"ppermute over {axes[0]!r} (size {size}): {problem}",
+                    )
+        if name in _SUM_PRIMS and emit:
+            for v in eqn.invars:
+                if _is_literal(v):
+                    # psum of a trace-time constant: either a symbolic-zero
+                    # transpose artifact or the deliberate psum(1) axis-size
+                    # idiom — never the overcount bug.
+                    continue
+                already = sorted(set(axes) & get(v)[1])
+                if already:
+                    self.add(
+                        "jaxpr-double-psum",
+                        f"{name} over axis(es) {already} of a value that was "
+                        "already reduced/gathered over them — each shard "
+                        "contributes the identical summed value, so the "
+                        "result is S-fold the intended sum (the shard_map-"
+                        "transpose overcount class)",
+                    )
+        # Output invariance + reduction taint:
+        axset = frozenset(axes)
+        if name == "psum" or name in _IDEMPOTENT_REDUCE_PRIMS:
+            for ov, v in zip(eqn.outvars, eqn.invars):
+                inv, red = get(v)
+                taint = axset if name == "psum" else frozenset()
+                env[ov] = (inv | axset, (red | taint) & (inv | axset))
+        elif name in _GATHER_PRIMS:
+            inv, red = get(eqn.invars[0])
+            for ov in eqn.outvars:
+                env[ov] = (inv | axset, (red | axset) & (inv | axset))
+        elif name == "axis_index":
+            for ov in eqn.outvars:
+                env[ov] = (frozenset(bound) - axset, frozenset())
+        elif name == "ppermute":
+            # permuting a replicated value is the identity; varying stays varying
+            for ov in eqn.outvars:
+                env[ov] = get(eqn.invars[0])
+        else:  # reduce_scatter, all_to_all, ...: shards end up with distinct pieces
+            for ov in eqn.outvars:
+                env[ov] = _VARYING
+
+    def _walk_scan(self, eqn, env, bound, emit, get) -> None:
+        body = _jaxpr_of(eqn.params.get("jaxpr"))
+        if body is None:
+            for ov in eqn.outvars:
+                env[ov] = _VARYING
+            return
+        nc = eqn.params.get("num_consts", 0)
+        ncar = eqn.params.get("num_carry", 0)
+        in_inv = [get(v) for v in eqn.invars]
+        carry_inv = list(in_inv[nc : nc + ncar])
+
+        def meet(a, b):
+            inv = a[0] & b[0]
+            return (inv, (a[1] | b[1]) & inv)
+
+        def body_pass(carry, do_emit):
+            ienv: dict = {}
+            seq = list(in_inv[:nc]) + list(carry) + list(in_inv[nc + ncar :])
+            for iv, inv in zip(body.invars, seq):
+                ienv[iv] = inv
+            for cv in getattr(body, "constvars", ()):
+                ienv[cv] = (frozenset(bound), frozenset())
+            self.walk(body, ienv, bound, do_emit)
+            outs = []
+            for ov in body.outvars:
+                outs.append(
+                    (frozenset(bound), frozenset()) if _is_literal(ov)
+                    else ienv.get(ov, _VARYING)
+                )
+            return outs
+
+        # Fixpoint on the carry's invariance (the invariant set only shrinks,
+        # so this terminates fast); findings emit only on the settled pass.
+        for _ in range(2 * len(bound) * max(ncar, 1) + 2):
+            outs = body_pass(carry_inv, do_emit=False)
+            new_carry = [meet(a, b) for a, b in zip(carry_inv, outs[:ncar])]
+            if new_carry == carry_inv:
+                break
+            carry_inv = new_carry
+        outs = body_pass(carry_inv, do_emit=emit)
+        for i, ov in enumerate(eqn.outvars):
+            if i < ncar:
+                env[ov] = carry_inv[i] if i < len(carry_inv) else _VARYING
+            else:
+                env[ov] = outs[i] if i < len(outs) else _VARYING
+
+    def _walk_cond(self, eqn, env, bound, emit, get) -> None:
+        branches = eqn.params.get("branches", ())
+        ops = eqn.invars[1:]
+        out_inv = None
+        for br in branches:
+            inner = _jaxpr_of(br)
+            if inner is None:
+                continue
+            ienv: dict = {}
+            if len(inner.invars) == len(ops):
+                for iv, v in zip(inner.invars, ops):
+                    ienv[iv] = get(v)
+            for cv in getattr(inner, "constvars", ()):
+                ienv[cv] = (frozenset(bound), frozenset())
+            self.walk(inner, ienv, bound, emit)
+            outs = [
+                (frozenset(bound), frozenset()) if _is_literal(ov)
+                else ienv.get(ov, _VARYING)
+                for ov in inner.outvars
+            ]
+            out_inv = outs if out_inv is None else [
+                ((a[0] & b[0]), (a[1] | b[1]) & (a[0] & b[0]))
+                for a, b in zip(out_inv, outs)
+            ]
+        for i, ov in enumerate(eqn.outvars):
+            env[ov] = (
+                out_inv[i] if out_inv is not None and i < len(out_inv)
+                else _VARYING
+            )
+
+    def _walk_call(self, eqn, subs, env, bound, emit, get) -> None:
+        """pjit / remat2 / custom_jvp / custom_vjp / closed_call: positional
+        1:1 operand mapping when the arity matches, varying otherwise."""
+        _, inner = subs[0]
+        ienv: dict = {}
+        if len(inner.invars) == len(eqn.invars):
+            for iv, v in zip(inner.invars, eqn.invars):
+                ienv[iv] = get(v)
+        for cv in getattr(inner, "constvars", ()):
+            ienv[cv] = (frozenset(bound), frozenset())
+        self.walk(inner, ienv, bound, emit)
+        # Extra sub-jaxprs (e.g. custom_vjp's fwd/bwd thunks are not Jaxprs;
+        # anything that is gets a conservative varying walk for the
+        # axis/bijection/f64 checks).
+        for _, extra in subs[1:]:
+            self.walk(extra, {}, bound, emit)
+        if len(inner.outvars) == len(eqn.outvars):
+            for ov, io in zip(eqn.outvars, inner.outvars):
+                env[ov] = (
+                    (frozenset(bound), frozenset()) if _is_literal(io)
+                    else ienv.get(io, _VARYING)
+                )
+        else:
+            for ov in eqn.outvars:
+                env[ov] = _VARYING
+
+    # -- bf16 upcast post-scan ----------------------------------------------
+
+    def _check_bf16_upcasts(self, jaxpr) -> None:
+        produced_by = {}
+        for eqn in jaxpr.eqns:
+            for ov in eqn.outvars:
+                produced_by[ov] = eqn
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name != "dot_general":
+                continue
+            for v in eqn.invars:
+                src = produced_by.get(v)
+                if src is None or src.primitive.name != "convert_element_type":
+                    continue
+                src_in = src.invars[0]
+                in_aval = getattr(src_in, "aval", None)
+                out_aval = getattr(v, "aval", None)
+                if (
+                    in_aval is not None
+                    and out_aval is not None
+                    and str(getattr(in_aval, "dtype", "")) == "bfloat16"
+                    and str(getattr(out_aval, "dtype", "")) == "float32"
+                    and getattr(out_aval, "size", 1) > 1
+                ):
+                    self.add(
+                        "jaxpr-bf16-upcast",
+                        "dot_general consumes an explicitly f32-upcast bf16 "
+                        "array inside a declared-bf16 region — halves the "
+                        "MXU rate silently; keep operands bf16 and use "
+                        "preferred_element_type=f32 for the accumulation",
+                    )
+
+
+def _collect_scans(jaxpr, out: list) -> None:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            body = _jaxpr_of(eqn.params.get("jaxpr"))
+            if body is not None:
+                out.append(body)
+        for _, inner in _sub_jaxprs(eqn.params):
+            _collect_scans(inner, out)
+
+
+def _contains_prim(jaxpr, names: set) -> bool:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in names:
+            return True
+        for _, inner in _sub_jaxprs(eqn.params):
+            if _contains_prim(inner, names):
+                return True
+    return False
+
+
+def audit_jaxpr(
+    jaxpr_or_closed,
+    *,
+    label: str,
+    bound_axes: dict | None = None,
+    expect_chunk_checkpoint: bool = False,
+    check_bf16_upcast: bool = False,
+) -> list[Finding]:
+    """Audit one (closed) jaxpr; returns the Findings.
+
+    ``bound_axes``: axis name -> size already bound OUTSIDE this jaxpr (for
+    auditing a bare shard_map body); normally empty — the walk binds axes at
+    the shard_map eqns it encounters.
+    """
+    auditor = _Auditor(label, check_bf16_upcast=check_bf16_upcast)
+    j = _jaxpr_of(jaxpr_or_closed)
+    if j is None:
+        raise TypeError(f"not a jaxpr: {jaxpr_or_closed!r}")
+    import numpy as np
+
+    bound = dict(bound_axes or {})
+    env: dict = {}
+    for iv in j.invars:
+        aval = getattr(iv, "aval", None)
+        dt = getattr(aval, "dtype", None)
+        # Float/complex only: a weak-typed float input is the classic python-
+        # scalar leak (0.1 vs np.float32(0.1) recompiles). Weak INT scalars
+        # are the flax convention (TrainState.step counts in a weak int32,
+        # stable across the whole run) — flagging them would be pure noise.
+        if (
+            getattr(aval, "weak_type", False)
+            and dt is not None
+            and np.issubdtype(dt, np.inexact)
+        ):
+            auditor.add(
+                "jaxpr-weak-type",
+                f"input aval {aval} is weak-typed — a python-scalar leak; "
+                "the compiled cache keys on weak_type, so passing a numpy "
+                "or jax scalar later recompiles the whole program",
+            )
+        # Top-level inputs are assumed varying (per-shard) — conservative.
+        env[iv] = _VARYING
+    for cv in getattr(j, "constvars", ()):
+        env[cv] = (frozenset(bound), frozenset())
+    auditor.walk(j, env, bound, emit=True)
+
+    if expect_chunk_checkpoint:
+        scans: list = []
+        _collect_scans(j, scans)
+        ok = any(
+            _contains_prim(body, _REMAT_PRIMS)
+            and _contains_prim(body, {"dot_general"})
+            for body in scans
+        )
+        if not ok:
+            auditor.add(
+                "jaxpr-chunk-checkpoint",
+                "no scan with a jax.checkpoint'd (remat) dot-bearing body "
+                "found — the chunked loss's backward would save every "
+                "block's logits instead of recomputing them, silently "
+                "re-materializing the full (local_b, W*local_b) matrix the "
+                "chunked path exists to avoid (PR 3 memory contract)",
+            )
+    return auditor.findings
+
+
+# ---------------------------------------------------------------------------
+# The six real step configs, traced abstractly (no compile, no execution).
+# ---------------------------------------------------------------------------
+
+
+def _abstract_batch(cfg, global_b: int):
+    import jax
+    import jax.numpy as jnp
+
+    v, t = cfg.vision, cfg.text
+    return {
+        "images": jax.ShapeDtypeStruct(
+            (global_b, v.image_size, v.image_size, 3), jnp.float32
+        ),
+        "tokens": jax.ShapeDtypeStruct(
+            (global_b, t.context_length), jnp.int32
+        ),
+    }
+
+
+def _abstract_params(model, batch):
+    import jax
+
+    import flax.linen as nn
+
+    boxed = jax.eval_shape(
+        lambda r, im, tk: model.init(r, im, tk)["params"],
+        jax.random.key(0), batch["images"], batch["tokens"],
+    )
+    return jax.tree.map(
+        lambda x: x.value if isinstance(x, nn.meta.AxisMetadata) else x,
+        boxed,
+        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
+    )
+
+
+def _abstract_state(model, tx, batch, ef_slices: int | None = None):
+    import jax
+
+    from distributed_sigmoid_loss_tpu.train.train_step import TrainState
+
+    params = _abstract_params(model, batch)
+    state = jax.eval_shape(
+        lambda p: TrainState.create(apply_fn=model.apply, params=p, tx=tx),
+        params,
+    )
+    if ef_slices is not None:
+        from distributed_sigmoid_loss_tpu.train.compressed_step import (
+            init_error_feedback,
+        )
+
+        ef = jax.eval_shape(lambda p: init_error_feedback(p, ef_slices), params)
+        state = state.replace(ef=ef)
+    return state
+
+
+def step_config_jaxprs(n_devices: int | None = None) -> dict:
+    """label -> (closed_jaxpr, audit_kwargs) for the six step configs, traced
+    on virtual CPU devices. Trace-only: tiny towers, abstract state/batch —
+    seconds, not the minutes a compile would cost."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.train import (
+        make_compressed_train_step,
+        make_optimizer,
+        make_train_step,
+    )
+    from distributed_sigmoid_loss_tpu.utils.config import (
+        LossConfig,
+        SigLIPConfig,
+        TrainConfig,
+    )
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = min(8, len(devices))
+    if n_devices < 4 or n_devices % 2:
+        raise RuntimeError(
+            f"the jaxpr audit needs an even mesh of >= 4 devices to cover "
+            f"all six step configs (got {n_devices}; run under "
+            f"--xla_force_host_platform_device_count or lint --cpu-devices)"
+        )
+    dp_mesh = Mesh(np.asarray(devices[:n_devices]), ("dp",))
+    dcn_mesh = Mesh(
+        np.asarray(devices[:n_devices]).reshape(2, n_devices // 2),
+        ("dcn", "dp"),
+    )
+
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    qt_cfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, quant_train="int8"),
+        text=dataclasses.replace(cfg.text, quant_train="int8"),
+    )
+    qt_model = SigLIP(qt_cfg)
+    tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+    batch = _abstract_batch(cfg, 2 * n_devices)
+    state = _abstract_state(model, tx, batch)
+    qt_state = _abstract_state(qt_model, tx, batch)
+    ef_state = _abstract_state(model, tx, batch, ef_slices=2)
+
+    builds = {
+        "fused": (
+            model, state,
+            lambda: make_train_step(
+                model, dp_mesh, LossConfig(variant="all_gather")
+            )[0],
+            {},
+        ),
+        "chunked": (
+            model, state,
+            lambda: make_train_step(
+                model, dp_mesh,
+                LossConfig(variant="all_gather", loss_impl="chunked"),
+            )[0],
+            {"expect_chunk_checkpoint": True},
+        ),
+        "ring": (
+            model, state,
+            lambda: make_train_step(model, dp_mesh, LossConfig())[0],
+            {},
+        ),
+        "ring_overlap": (
+            model, state,
+            lambda: make_train_step(
+                model, dp_mesh, LossConfig(ring_overlap=True)
+            )[0],
+            {},
+        ),
+        "compressed_dcn": (
+            model, ef_state,
+            lambda: make_compressed_train_step(
+                model, dcn_mesh, LossConfig(variant="all_gather")
+            )[0],
+            {},
+        ),
+        "quant_train_int8": (
+            qt_model, qt_state,
+            lambda: make_train_step(qt_model, dp_mesh, LossConfig())[0],
+            {},
+        ),
+    }
+    out = {}
+    for label, (_, st, build, kwargs) in builds.items():
+        step = build()
+        out[label] = (jax.make_jaxpr(step)(st, batch), kwargs)
+    return out
+
+
+def audit_default_step_configs(n_devices: int | None = None) -> list[Finding]:
+    """Audit all six step configs; the tier-1/dryrun entry point."""
+    findings: list[Finding] = []
+    for label, (closed, kwargs) in step_config_jaxprs(n_devices).items():
+        findings.extend(audit_jaxpr(closed, label=label, **kwargs))
+    return findings

@@ -19,6 +19,11 @@ The subcommands tie the subsystems together:
   + LRU cache + retrieval index) on synthetic data; prints the ``stats()``
   snapshot (qps, latency percentiles, batch histogram, cache hit rate, compile
   count) as one JSON record. CPU-runnable — docs/SERVING.md.
+- ``lint`` — graftlint: the repo-invariant AST linter plus the jaxpr
+  collective/dtype auditor traced over the six real step configs on an
+  emulated CPU mesh (exit 1 on findings, ``--json``, per-rule ``--disable``).
+  The same analyzers run in tier-1 (tests/test_analysis.py) and the dryrun —
+  docs/ANALYSIS.md.
 
 ``train`` and ``eval`` accept ``--cpu-devices N`` to emulate an N-chip mesh on
 CPU — the TPU-native analogue of the reference's ``mp.spawn`` + Gloo localhost
@@ -1424,6 +1429,54 @@ def cmd_serve_bench(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Run graftlint: the repo-invariant AST linter plus (default) the jaxpr
+    collective/dtype auditor over the six real step configs on an emulated
+    CPU mesh. Exit 0 = clean, 1 = findings, 2 = usage error.
+
+    Rule catalog + allowlist policy: docs/ANALYSIS.md. The same entry points
+    run inside tests/test_analysis.py and the __graft_entry__ dryrun, so a
+    finding here is a tier-1 failure — `lint` is the local preview.
+    """
+    # The auditor traces shard_map'd steps, which needs a multi-device mesh;
+    # default to the 8-virtual-device CPU bootstrap the tests use.
+    if not args.no_jaxpr and not args.cpu_devices:
+        args.cpu_devices = 8
+    _bootstrap_devices(args)
+    import json as jsonmod
+
+    from distributed_sigmoid_loss_tpu.analysis import ALL_RULES, run_lint
+
+    unknown = [r for r in args.disable if r not in ALL_RULES]
+    if unknown:
+        print(
+            f"--disable: unknown rule(s) {unknown}; known rules: "
+            + ", ".join(ALL_RULES),
+            file=sys.stderr,
+        )
+        return 2
+    findings = run_lint(disabled=set(args.disable), jaxpr=not args.no_jaxpr)
+    checked = [r for r in ALL_RULES if r not in args.disable]
+    if args.no_jaxpr:
+        checked = [r for r in checked if not r.startswith("jaxpr-")]
+    if args.json:
+        print(jsonmod.dumps({
+            "rules_checked": checked,
+            "disabled": sorted(args.disable),
+            "findings": [f.as_dict() for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f)
+    print(
+        f"graftlint: {len(checked)} rules checked, {len(findings)} "
+        f"finding(s)" + (f", {len(args.disable)} disabled" if args.disable
+                         else ""),
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
 def cmd_tokenizer(args) -> int:
     """Train a BPE vocab from captions and write it as json."""
     import glob as globmod
@@ -1764,6 +1817,26 @@ def main(argv=None) -> int:
     sb.add_argument("--cpu-devices", type=int, default=0,
                     help="emulate N CPU devices (pair with --mesh)")
 
+    ln = sub.add_parser(
+        "lint",
+        help="graftlint: repo-invariant linter + jaxpr collective/dtype "
+             "auditor over the six step configs (exit 1 on findings); "
+             "rule catalog in docs/ANALYSIS.md",
+    )
+    ln.add_argument("--json", action="store_true",
+                    help="machine-readable report (rules checked + findings) "
+                         "instead of one text line per finding")
+    ln.add_argument("--disable", action="append", default=[], metavar="RULE",
+                    help="skip this rule id (repeatable); see docs/ANALYSIS.md "
+                         "for the catalog — prefer fixing or allowlisting "
+                         "with a rationale over disabling")
+    ln.add_argument("--no-jaxpr", action="store_true",
+                    help="AST rules only (skip tracing the six step configs; "
+                         "sub-second, for pre-commit-style hooks)")
+    ln.add_argument("--cpu-devices", type=int, default=0,
+                    help="virtual CPU mesh size for the jaxpr auditor "
+                         "(default 8 — the same emulated mesh the tests use)")
+
     argv = sys.argv[1:] if argv is None else list(argv)
     # bench forwards its arguments to bench.py untouched; argparse REMAINDER
     # cannot capture a LEADING option (`bench --use-pallas` errors), so bench is
@@ -1779,6 +1852,7 @@ def main(argv=None) -> int:
         "tokenizer": cmd_tokenizer,
         "bench": lambda a: cmd_bench(a.rest),
         "serve-bench": cmd_serve_bench,
+        "lint": cmd_lint,
     }
     return dispatch[args.cmd](args)
 

@@ -28,7 +28,61 @@ __all__ = [
     "neighbour_exchange_bidir",
     "double_buffered_scan",
     "pvary",
+    "ring_perm_problems",
+    "validate_ring_perm",
 ]
+
+
+def ring_perm_problems(perm, axis_size: int) -> list:
+    """Why ``perm`` is NOT a total bijection on an axis of ``axis_size``.
+
+    THE shared bijection check: the trace-time guard below and the jaxpr
+    auditor (analysis/jaxpr_audit.py, rule ``jaxpr-ppermute-bijection``) both
+    call it, so the runtime error and the static finding can never disagree
+    about what a valid ring permutation is. A non-bijective perm silently
+    zero-fills the shards nobody sends to (``ppermute`` semantics) — the
+    broken-ring class: the loss simply loses negative blocks, with no error.
+
+    Returns a list of human-readable problem strings; empty = bijection.
+    """
+    problems = []
+    try:
+        pairs = [(int(s), int(d)) for s, d in perm]
+    except (TypeError, ValueError):
+        return [f"perm is not a sequence of (src, dst) pairs: {perm!r}"]
+    oob = [p for p in pairs if not (0 <= p[0] < axis_size and 0 <= p[1] < axis_size)]
+    if oob:
+        problems.append(f"pairs out of range [0, {axis_size}): {oob}")
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    dup_src = sorted({s for s in srcs if srcs.count(s) > 1})
+    dup_dst = sorted({d for d in dsts if dsts.count(d) > 1})
+    if dup_src:
+        problems.append(f"duplicate source shard(s) {dup_src} (send twice)")
+    if dup_dst:
+        problems.append(
+            f"duplicate destination shard(s) {dup_dst} (collide; the shards "
+            "nobody sends to receive ZEROS)"
+        )
+    if not problems and len(pairs) != axis_size:
+        missing = sorted(set(range(axis_size)) - set(srcs))
+        problems.append(
+            f"partial permutation: only {len(pairs)}/{axis_size} shards "
+            f"send (shard(s) {missing} drop their payload and their "
+            "neighbors receive zeros)"
+        )
+    return problems
+
+
+def validate_ring_perm(perm, axis_size: int, axis_name) -> None:
+    """Trace-time twin of the auditor's bijection rule: raise a clear error
+    naming the axis and size when ``perm`` is not a total bijection."""
+    problems = ring_perm_problems(perm, axis_size)
+    if problems:
+        raise ValueError(
+            f"ppermute permutation over axis {axis_name!r} (size {axis_size}) "
+            "is not a bijection: " + "; ".join(problems)
+        )
 
 
 def pvary(x: jax.Array, axis_name):
@@ -56,14 +110,18 @@ def ring_shift_right(x: jax.Array, axis_name: str) -> jax.Array:
     from_rank/to_rank (distributed_utils.py:74-77).
     """
     w = lax.axis_size(axis_name)
-    return lax.ppermute(x, axis_name, perm=_ring_perm(w, +1))
+    perm = _ring_perm(w, +1)
+    validate_ring_perm(perm, w, axis_name)
+    return lax.ppermute(x, axis_name, perm=perm)
 
 
 def ring_shift_left(x: jax.Array, axis_name: str) -> jax.Array:
     """Mirror of :func:`ring_shift_right`: send to ``(i-1) % W``, receive from the
     right neighbor."""
     w = lax.axis_size(axis_name)
-    return lax.ppermute(x, axis_name, perm=_ring_perm(w, -1))
+    perm = _ring_perm(w, -1)
+    validate_ring_perm(perm, w, axis_name)
+    return lax.ppermute(x, axis_name, perm=perm)
 
 
 def neighbour_exchange(x: jax.Array, axis_name: str, *, to_right: bool = True):

@@ -1,0 +1,477 @@
+"""graftlint self-enforcement: the analyzers run green on the shipped tree,
+and every rule is falsified on a known-bad fixture (no rule ships untested —
+a rule that cannot fire is a rule that silently stopped protecting anything).
+
+Standard tier: the jaxpr audit is trace-only (no compile) — the six-config
+sweep runs in ~10 s on this host; everything else is AST/pure-python.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import distributed_sigmoid_loss_tpu  # noqa: F401  (compat shims first)
+from jax import shard_map
+
+from distributed_sigmoid_loss_tpu.analysis import (
+    ALL_RULES,
+    JAXPR_RULES,
+    Finding,
+    run_lint,
+)
+from distributed_sigmoid_loss_tpu.analysis import jaxpr_audit, repo_lint
+from distributed_sigmoid_loss_tpu.analysis.bench_schema import validate_record
+from distributed_sigmoid_loss_tpu.parallel.collectives import (
+    ring_perm_problems,
+    validate_ring_perm,
+)
+
+
+def _mesh8():
+    return Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _audit_rules(fn, *args, **kwargs):
+    return _rules_of(
+        jaxpr_audit.audit_jaxpr(jax.make_jaxpr(fn)(*args), label="fixture",
+                                **kwargs)
+    )
+
+
+# ---------------------------------------------------------------------------
+# jaxpr rules: each known-bad fixture trips exactly its rule
+# ---------------------------------------------------------------------------
+
+
+def test_broken_ring_perm_trips_bijection_rule():
+    """Everyone sends to shard 0: duplicate destinations, shards 1..7 receive
+    zeros — the broken-ring class. Trips the bijection rule and nothing else."""
+    mesh = _mesh8()
+    bad = [(i, 0) for i in range(8)]
+    fn = shard_map(
+        lambda z: lax.ppermute(z, "dp", bad),
+        mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"), check_vma=False,
+    )
+    assert _audit_rules(fn, jnp.ones((8, 4))) == ["jaxpr-ppermute-bijection"]
+
+
+def test_partial_ring_perm_trips_bijection_rule():
+    mesh = _mesh8()
+    partial = [(i, (i + 1) % 8) for i in range(4)]  # only half the ring sends
+    fn = shard_map(
+        lambda z: lax.ppermute(z, "dp", partial),
+        mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"), check_vma=False,
+    )
+    assert _audit_rules(fn, jnp.ones((8, 4))) == ["jaxpr-ppermute-bijection"]
+
+
+def test_double_psum_trips_overcount_rule():
+    """psum of a psum over the same axis: each shard re-contributes the
+    identical global sum — the S-fold overcount class."""
+    mesh = _mesh8()
+    fn = shard_map(
+        lambda z: lax.psum(lax.psum(z, "dp"), "dp"),
+        mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False,
+    )
+    assert _audit_rules(fn, jnp.ones((8, 4))) == ["jaxpr-double-psum"]
+
+
+def test_pmean_backward_is_not_flagged():
+    """jax's psum-self-transpose convention (pmean backward psums a replicated
+    cotangent, compensated by the 1/S) must NOT trip the overcount rule."""
+    mesh = _mesh8()
+    fn = jax.grad(
+        shard_map(
+            lambda z: lax.pmean(jnp.sum(z**2), "dp"),
+            mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False,
+        )
+    )
+    assert _audit_rules(fn, jnp.ones((8, 4))) == []
+
+
+def test_unbound_axis_trips_collective_axis_rule():
+    """A shard_map BODY audited standalone (no axis bound): its psum names an
+    axis nothing binds — the stale/foreign axis-environment class."""
+    mesh = _mesh8()
+    closed = jax.make_jaxpr(
+        shard_map(
+            lambda z: lax.psum(z, "dp"),
+            mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False,
+        )
+    )(jnp.ones((8, 4)))
+    inner = [
+        e for e in closed.jaxpr.eqns if e.primitive.name == "shard_map"
+    ][0].params["jaxpr"]
+    findings = jaxpr_audit.audit_jaxpr(inner, label="fixture")
+    assert _rules_of(findings) == ["jaxpr-collective-axis"]
+    # ...and with the axis properly declared, the same body audits clean.
+    assert jaxpr_audit.audit_jaxpr(
+        inner, label="fixture", bound_axes={"dp": 8}
+    ) == []
+
+
+def test_missing_chunk_checkpoint_trips_and_checkpointed_passes():
+    mesh = _mesh8()
+
+    def chunk_loss(checkpointed):
+        def raw_body(acc, c):
+            return acc + (z_ref[0] @ c.T).sum(), None
+
+        def fn(z):
+            z_ref[0] = z
+            body = jax.checkpoint(raw_body) if checkpointed else raw_body
+            out, _ = lax.scan(body, 0.0, lax.all_gather(z, "dp"))
+            return out
+
+        z_ref = [None]
+        return shard_map(
+            fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P(),
+            check_vma=False,
+        )
+
+    x = jnp.ones((8, 4))
+    assert _audit_rules(
+        chunk_loss(False), x, expect_chunk_checkpoint=True
+    ) == ["jaxpr-chunk-checkpoint"]
+    assert _audit_rules(
+        chunk_loss(True), x, expect_chunk_checkpoint=True
+    ) == []
+
+
+def test_weak_float_input_trips_and_int_counter_is_exempt():
+    # python float scalar input -> weak f32 aval -> recompile hazard
+    assert _audit_rules(lambda s: s * 2.0, 3.5) == ["jaxpr-weak-type"]
+    # weak INT scalar (the flax TrainState.step convention) stays silent
+    assert _audit_rules(lambda s: s + 1, 3) == []
+
+
+def test_f64_aval_trips_dtype_rule():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        rules = _audit_rules(
+            lambda z: z.astype("float64") * 2, jnp.ones((4,), jnp.float32)
+        )
+    assert rules == ["jaxpr-f64"]
+
+
+def test_bf16_upcast_trips_and_preferred_element_type_passes():
+    a = jnp.ones((4, 4), jnp.bfloat16)
+
+    def upcast(x, y):
+        return x.astype(jnp.float32) @ y.astype(jnp.float32).T
+
+    def sanctioned(x, y):
+        return lax.dot_general(
+            x, y, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    assert _audit_rules(upcast, a, a, check_bf16_upcast=True) == [
+        "jaxpr-bf16-upcast"
+    ]
+    assert _audit_rules(sanctioned, a, a, check_bf16_upcast=True) == []
+
+
+# ---------------------------------------------------------------------------
+# the real programs audit green, covering all six step configs
+# ---------------------------------------------------------------------------
+
+
+def test_six_step_configs_audit_green_and_cover_all_paths():
+    jaxprs = jaxpr_audit.step_config_jaxprs()
+    assert set(jaxprs) == set(jaxpr_audit.DEFAULT_STEP_CONFIGS)
+    assert set(jaxprs) == {
+        "fused", "chunked", "ring", "ring_overlap", "compressed_dcn",
+        "quant_train_int8",
+    }
+    all_findings = []
+    for label, (closed, kwargs) in jaxprs.items():
+        all_findings += jaxpr_audit.audit_jaxpr(closed, label=label, **kwargs)
+    assert all_findings == [], [str(f) for f in all_findings]
+    # The audit is load-bearing only if the programs actually contain the
+    # comm structure it checks: the ring configs must carry ppermutes, the
+    # all-gather ones all_gathers, chunked a remat'd scan.
+    def prims(closed):
+        out = set()
+
+        def rec(j):
+            for e in j.eqns:
+                out.add(e.primitive.name)
+                for _, inner in jaxpr_audit._sub_jaxprs(e.params):
+                    rec(inner)
+
+        rec(closed.jaxpr)
+        return out
+
+    assert "ppermute" in prims(jaxprs["ring"][0])
+    assert "ppermute" in prims(jaxprs["ring_overlap"][0])
+    assert "all_gather" in prims(jaxprs["fused"][0])
+    assert "all_gather" in prims(jaxprs["chunked"][0])
+    assert "psum" in prims(jaxprs["compressed_dcn"][0])
+
+
+def test_rule_catalogs_agree():
+    assert tuple(JAXPR_RULES) == tuple(jaxpr_audit.JAXPR_RULES)
+    assert set(repo_lint.REPO_RULES) | set(JAXPR_RULES) == set(ALL_RULES)
+
+
+# ---------------------------------------------------------------------------
+# runtime twin of the bijection rule (parallel/collectives.py)
+# ---------------------------------------------------------------------------
+
+
+def test_validate_ring_perm_raises_naming_axis_and_size():
+    with pytest.raises(ValueError) as e:
+        validate_ring_perm([(0, 1), (1, 1)], 2, "dp")
+    msg = str(e.value)
+    assert "'dp'" in msg and "size 2" in msg and "destination" in msg
+    # the shared problem list is what the jaxpr auditor consumes
+    assert ring_perm_problems([(i, (i + 1) % 8) for i in range(8)], 8) == []
+    assert ring_perm_problems([(0, 1)], 8)  # partial
+    assert ring_perm_problems([(0, 9)], 8)  # out of range
+
+
+def test_ring_helpers_still_trace_clean():
+    from distributed_sigmoid_loss_tpu.parallel.collectives import (
+        ring_shift_left,
+        ring_shift_right,
+    )
+
+    mesh = _mesh8()
+    fn = shard_map(
+        lambda z: ring_shift_left(ring_shift_right(z, "dp"), "dp"),
+        mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"), check_vma=False,
+    )
+    assert _audit_rules(fn, jnp.ones((8, 4))) == []
+
+
+# ---------------------------------------------------------------------------
+# repo-lint rules: green tree + one known-bad fixture each
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lint_green_on_shipped_tree():
+    findings = repo_lint.run_repo_lint()
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_unregistered_mutable_global_trips():
+    src = (
+        "_CACHE = {}\n"
+        "_MODE = False\n"
+        "def set_mode(v):\n"
+        "    global _MODE\n"
+        "    _MODE = v\n"
+        "def put(k, v):\n"
+        "    _CACHE[k] = v\n"
+    )
+    findings = repo_lint.check_mutable_globals(
+        sources={"fake/mod.py": src}, allowlist={}
+    )
+    assert _rules_of(findings) == ["repo-mutable-global"]
+    assert {f.subject for f in findings} == {
+        "fake/mod.py::_CACHE", "fake/mod.py::_MODE"
+    }
+    # allowlisted -> green; stale allowlist entry -> finding again
+    assert repo_lint.check_mutable_globals(
+        sources={"fake/mod.py": src},
+        allowlist={"fake/mod.py::_CACHE": "r", "fake/mod.py::_MODE": "r"},
+    ) == []
+    stale = repo_lint.check_mutable_globals(
+        sources={"fake/mod.py": "X = 1\n"},
+        allowlist={"fake/mod.py::_GONE": "r"},
+    )
+    assert _rules_of(stale) == ["repo-mutable-global"]
+    assert "stale" in stale[0].detail
+
+
+FAKE_BENCH = """
+import argparse
+
+_SHIELD_EXEMPT_FLAGS = {{
+    "steps": "trip count only",
+{extra_exempt}
+}}
+
+def _fresh_compile_config(args):
+    return bool(args.moe)
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int)
+    ap.add_argument("--moe", type=int)
+    ap.add_argument("--frobnicate", action="store_true")
+"""
+
+
+def test_unshielded_fake_bench_flag_trips():
+    findings = repo_lint.check_bench_shield(
+        FAKE_BENCH.format(extra_exempt="")
+    )
+    assert _rules_of(findings) == ["repo-bench-shield"]
+    assert [f.subject for f in findings] == ["bench.py::frobnicate"]
+    # classified (exempted) -> green
+    assert repo_lint.check_bench_shield(
+        FAKE_BENCH.format(extra_exempt='    "frobnicate": "measurement-only",')
+    ) == []
+    # stale exemption -> finding
+    stale = repo_lint.check_bench_shield(
+        FAKE_BENCH.format(
+            extra_exempt='    "frobnicate": "x",\n    "gone": "stale",'
+        )
+    )
+    assert [f.subject for f in stale] == ["bench.py::gone"]
+
+
+def test_undocumented_cli_flag_trips_doc_rule():
+    cli_src = (
+        "import argparse\n"
+        "ap = argparse.ArgumentParser()\n"
+        'ap.add_argument("--frobnicate")\n'
+    )
+    cfg_src = "class LossConfig:\n    variant: str = 'ring'\n"
+    findings = repo_lint.check_doc_staleness(
+        cli_source=cli_src, config_source=cfg_src,
+        docs_text="docs mention variant but not the flag",
+    )
+    assert _rules_of(findings) == ["repo-doc-stale"]
+    assert findings[0].subject == "cli.py::--frobnicate"
+    assert repo_lint.check_doc_staleness(
+        cli_source=cli_src, config_source=cfg_src,
+        docs_text="--frobnicate and variant are documented",
+    ) == []
+
+
+def test_slow_suite_without_marker_trips():
+    findings = repo_lint.check_slow_markers(
+        sources={"test_cli.py": "def test_x():\n    pass\n"},
+        required=("test_cli.py",),
+    )
+    assert _rules_of(findings) == ["repo-slow-marker"]
+    assert repo_lint.check_slow_markers(
+        sources={
+            "test_cli.py": "import pytest\npytestmark = pytest.mark.slow\n"
+        },
+        required=("test_cli.py",),
+    ) == []
+    missing = repo_lint.check_slow_markers(
+        sources={"test_cli.py": None}, required=("test_cli.py",)
+    )
+    assert _rules_of(missing) == ["repo-slow-marker"]
+
+
+def test_unregistered_bench_record_field_trips():
+    src = 'record = {"metric": "m", "value": 1.0, "bogus_field": 2}\n'
+    findings = repo_lint.check_bench_record_fields(src)
+    assert _rules_of(findings) == ["repo-bench-record"]
+    assert findings[0].subject == "bench.py::bogus_field"
+    # subscript-assign and _emit literals are covered too
+    assert repo_lint.check_bench_record_fields(
+        'record["another_bogus"] = 1\n'
+    )[0].subject == "bench.py::another_bogus"
+    assert repo_lint.check_bench_record_fields(
+        '_emit({"metric": "m", "value": 0.0, "unit": "x"})\n'
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# bench record schema (shared by bench.py _emit and the lint rule)
+# ---------------------------------------------------------------------------
+
+
+def test_validate_record_contract():
+    assert validate_record(
+        {"metric": "m", "value": 1.0, "unit": "pairs/s/chip"}
+    ) == []
+    missing = validate_record({"value": 1.0})
+    assert any("metric" in p for p in missing)
+    unknown = validate_record(
+        {"metric": "m", "value": 0.0, "unit": "x", "bogus": 1}
+    )
+    assert any("bogus" in p for p in unknown)
+    assert validate_record([1, 2]) != []
+
+
+def test_bench_emit_paths_validate_against_schema(capsys):
+    import argparse
+
+    import bench
+
+    args = argparse.Namespace(
+        eval_throughput=False, context=0, moe_breakdown=False,
+        step_breakdown=False, metric_suffix="", model="tiny", batch=4,
+        steps=2,
+    )
+    bench.emit_backend_error(args, "drill")
+    out, err = capsys.readouterr()
+    rec = json.loads(out.strip())
+    assert validate_record(rec) == []
+    assert "schema violation" not in err
+    # and the validator actually guards _emit: an unregistered field warns
+    bench._emit({"metric": "m", "value": 0.0, "unit": "x", "bogus": 1})
+    out, err = capsys.readouterr()
+    assert json.loads(out.strip())["bogus"] == 1  # record never lost
+    assert "schema violation" in err
+
+
+# ---------------------------------------------------------------------------
+# the `lint` CLI subcommand
+# ---------------------------------------------------------------------------
+
+
+def test_cli_lint_ast_only_green(capsys):
+    from distributed_sigmoid_loss_tpu.cli import main
+
+    assert main(["lint", "--no-jaxpr"]) == 0
+    out, err = capsys.readouterr()
+    assert "0 finding(s)" in err
+
+
+def test_cli_lint_json_report(capsys):
+    from distributed_sigmoid_loss_tpu.cli import main
+
+    assert main(["lint", "--no-jaxpr", "--json",
+                 "--disable", "repo-doc-stale"]) == 0
+    out, _ = capsys.readouterr()
+    report = json.loads(out)
+    assert report["findings"] == []
+    assert "repo-doc-stale" in report["disabled"]
+    assert "repo-bench-shield" in report["rules_checked"]
+    assert "repo-doc-stale" not in report["rules_checked"]
+
+
+def test_cli_lint_unknown_rule_is_usage_error(capsys):
+    from distributed_sigmoid_loss_tpu.cli import main
+
+    assert main(["lint", "--no-jaxpr", "--disable", "bogus-rule"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_cli_lint_exits_1_on_findings(capsys, monkeypatch):
+    import distributed_sigmoid_loss_tpu.analysis as analysis
+    from distributed_sigmoid_loss_tpu.cli import main
+
+    monkeypatch.setattr(
+        analysis, "run_lint",
+        lambda **kw: [Finding("repo-doc-stale", "x", "drill finding")],
+    )
+    assert main(["lint", "--no-jaxpr"]) == 1
+    out, err = capsys.readouterr()
+    assert "drill finding" in out
+    assert "1 finding(s)" in err
+
+
+def test_run_lint_full_green():
+    """The exact call tier-1/dryrun makes: AST + all six jaxpr configs."""
+    findings = run_lint()
+    assert findings == [], [str(f) for f in findings]

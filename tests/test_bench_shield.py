@@ -105,7 +105,8 @@ def _bench_module():
 
 def _bench_args(**overrides):
     """A Namespace with the exact flag surface _fresh_compile_config reads,
-    at headline-run defaults."""
+    at headline-run defaults (test_shield_surface_matches_bench_source pins
+    this dict against bench.py's REAL reads, so it can't silently rot)."""
     import argparse
 
     defaults = dict(
@@ -113,9 +114,57 @@ def _bench_args(**overrides):
         attn_impl="auto", text_attn_impl="", attn_bwd="loop",
         accum_negatives="local", gradcache_bf16=False, quant_train="",
         loss_impl="fused", ring_overlap=False,
+        # round-8 graftlint classification pass: the remaining
+        # program-changing flags joined the shield.
+        eval_throughput=False, quant="", use_pallas=False, variant="ring",
+        loss_family="sigmoid", precision="default", zero1=False,
+        no_text_remat=False, scan_layers=False, steps_per_call=1,
     )
     defaults.update(overrides)
     return argparse.Namespace(**defaults)
+
+
+def test_shield_surface_matches_bench_source():
+    """_bench_args' surface IS _fresh_compile_config's read set, enumerated
+    from bench.py's source — not a hand-copied list that can drift. And every
+    argparse flag is classified: shield reads + _SHIELD_EXEMPT_FLAGS cover
+    the whole tree (the graftlint repo-bench-shield invariant)."""
+    import ast
+
+    from distributed_sigmoid_loss_tpu.analysis import repo_lint
+
+    with open(BENCH, encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src)
+    reads = repo_lint._attr_reads_of(tree, "_fresh_compile_config")
+    assert reads == set(vars(_bench_args())), (
+        "update _bench_args defaults to match _fresh_compile_config's reads"
+    )
+    assert repo_lint.check_bench_shield(src) == []
+
+
+def test_fresh_compile_config_covers_round8_program_flags():
+    """The graftlint classification pass: every remaining program-changing
+    flag triggers the shield; headline-recipe components stay exempt (their
+    programs ARE the warm cache)."""
+    bench = _bench_module()
+    for kw in (
+        dict(eval_throughput=True),
+        dict(eval_throughput=True, quant="int8"),
+        dict(use_pallas=True),
+        dict(variant="all_gather"),
+        dict(loss_family="softmax"),
+        dict(precision="highest"),
+        dict(zero1=True),
+        dict(no_text_remat=True),
+        dict(scan_layers=True),
+        dict(steps_per_call=5),
+    ):
+        assert bench._fresh_compile_config(_bench_args(**kw)), kw
+    # The no-args driver recipes (headline + 32k-equiv) must stay UNshielded:
+    # their flag set reads at defaults here (accum/accum-bf16/mu-bf16/
+    # remat-policy are exempt, not shield reads).
+    assert not bench._fresh_compile_config(_bench_args())
 
 
 def test_fresh_compile_config_covers_gradcache_variants():
@@ -194,11 +243,19 @@ def test_signal_after_child_exit_relays_record_not_deferral(tmp_path, capsys):
 
 
 def test_signal_after_child_exit_without_record_reports_exit(tmp_path, capsys):
+    from distributed_sigmoid_loss_tpu.analysis.bench_schema import (
+        validate_record,
+    )
+
     recs = _signal_record_lines(tmp_path, capsys, rc=3, child_stdout_text="")
     (rec,) = recs
     assert "deferred" not in rec
     assert rec["value"] == 0.0
     assert "already exited rc=3" in rec["error"]
+    # Every emit path speaks the ONE declared record schema
+    # (analysis/bench_schema.py; the repo-bench-record lint rule is the
+    # static twin of this assertion).
+    assert validate_record(rec) == []
 
 
 def test_signal_with_live_child_still_defers(tmp_path, capsys):
@@ -216,6 +273,11 @@ def test_signal_with_live_child_still_defers(tmp_path, capsys):
     (rec,) = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
     assert rec["deferred"] is True
     assert rec["child_pid"] == 12345
+    from distributed_sigmoid_loss_tpu.analysis.bench_schema import (
+        validate_record,
+    )
+
+    assert validate_record(rec) == []
 
 
 def test_attn_bwd_record_uses_traced_choice_not_argv():
