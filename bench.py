@@ -119,6 +119,8 @@ def _metric_for_mode(args) -> tuple[str, str]:
     """(metric, unit) the given invocation would report — shared by the
     backend-error and compile-shield deferral records so per-metric streams
     always see the name the bench that never ran would have used."""
+    if getattr(args, "data_bench", False):
+        return "data_bench_pipeline_pairs_per_sec", "pairs/s"
     if getattr(args, "eval_throughput", False):
         return (
             f"siglip_vit{args.model}_eval_pairs_per_sec_per_chip",
@@ -254,6 +256,8 @@ _SHIELD_EXEMPT_FLAGS = {
     "moe_k": "only meaningful with --moe, which is already a shield trigger",
     "moe_group_size": "only meaningful with --moe (shield trigger)",
     "moe_cf": "only meaningful with --moe (shield trigger)",
+    "data_workers": "host-side worker-pool size only (decode/generation "
+                    "threads); the compiled programs are byte-identical",
 }
 
 
@@ -293,6 +297,9 @@ def _fresh_compile_config(args) -> bool:
         # from the headline recipes, so none sits in the warm cache.
         or args.eval_throughput  # forward-only program + optional int8 dots
         or bool(args.quant)      # rides --eval-throughput; int8 program
+        # data-bench jits the augment/commit programs — tiny, but none of
+        # them sit in the warm cache of routine headline runs.
+        or args.data_bench
         or args.use_pallas
         or args.variant != "ring"
         or args.loss_family != "sigmoid"
@@ -1036,6 +1043,25 @@ def run_moe_breakdown(args) -> int:
     return 0
 
 
+def run_data_bench_mode(args) -> int:
+    """--data-bench: delegate to the package's stage-level input-pipeline
+    runner (data/data_bench.py — the same code path as the CPU-runnable
+    `python -m distributed_sigmoid_loss_tpu data-bench`), mapping the bench
+    positionals onto its surface: batch → global batch, steps → timed
+    batches, model → tower shape. Records are schema-validated by the runner
+    itself; generated-shard defaults keep the run self-contained on the chip
+    host."""
+    from distributed_sigmoid_loss_tpu.data.data_bench import run_data_bench
+
+    ns = argparse.Namespace(
+        batch=args.batch, batches=args.steps, model=args.model,
+        data_shards="", data_workers=args.data_workers, image_hw="240x320",
+        shards=4, pil_decode=False, no_read_ahead=False, no_pipelined=False,
+        no_zero_copy=False, seed=0,
+    )
+    return run_data_bench(ns)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     # 288/chip, save_hot remat, unrolled layers is the measured single-chip sweet
@@ -1169,6 +1195,19 @@ def main():
                          "full-precision VJP backward — the int8 training "
                          "track's headline lever (docs/PERF.md roofline "
                          "rationale); recipes tag records via --metric-suffix")
+    ap.add_argument("--data-bench", action="store_true",
+                    help="input-pipeline stage bench INSTEAD of the train "
+                         "bench: shard read / decode / tokenize / augment / "
+                         "h2d commit in isolation + the composed real-data "
+                         "pipeline vs the synthetic loader (generated JPEG "
+                         "shards; batch/steps/model map to global batch, "
+                         "timed batches, tower shape) — the host-side proof "
+                         "the headline rate can be FED (docs/PERF.md "
+                         "'Feeding the headline')")
+    ap.add_argument("--data-workers", type=int, default=0, metavar="N",
+                    help="with --data-bench: host decode/generation worker "
+                         "threads (0 = auto: cpu_count minus the "
+                         "prefetch/main threads; resolved value recorded)")
     ap.add_argument("--context", type=int, default=0, metavar="SEQ",
                     help="long-context attention bench INSTEAD of the train "
                          "bench: time one transformer block fwd+bwd at this "
@@ -1222,6 +1261,7 @@ def main():
         "--context": bool(args.context),
         "--moe-breakdown": args.moe_breakdown,
         "--step-breakdown": args.step_breakdown,
+        "--data-bench": args.data_bench,
     }
     picked_modes = [k for k, v in modes.items() if v]
     if len(picked_modes) > 1:
@@ -1255,6 +1295,43 @@ def main():
             ap.error(f"--eval-throughput does not support {' '.join(bad)} "
                      "(forward-only: no loss, no optimizer; PTQ serving is "
                      "--quant int8)")
+    if args.data_bench:
+        # The host-pipeline bench never builds the train step: every flag
+        # that would change that program is refused, not dropped (same
+        # honest-records rule as --eval-throughput/--step-breakdown). The
+        # honored set: batch/steps/model positionals + --data-workers.
+        unsupported = {
+            "--accum": args.accum != 1, "--zero1": args.zero1,
+            "--mu-bf16": args.mu_bf16, "--accum-bf16": args.accum_bf16,
+            "--remat-policy": bool(args.remat_policy),
+            "--metric-suffix": bool(args.metric_suffix),
+            "--no-text-remat": args.no_text_remat,
+            "--steps-per-call": args.steps_per_call != 1,
+            "--use-pallas": args.use_pallas,
+            "--variant": args.variant != "ring",
+            "--loss-family": args.loss_family != "sigmoid",
+            "--precision": args.precision != "default",
+            "--accum-negatives": args.accum_negatives != "local",
+            "--gradcache-bf16": args.gradcache_bf16,
+            "--attn-bwd": args.attn_bwd != "loop",
+            "--attn-impl": args.attn_impl != "auto",
+            "--text-attn-impl": bool(args.text_attn_impl),
+            "--scan-layers": args.scan_layers,
+            "--moe": bool(args.moe),
+            "--quant": bool(args.quant),
+            "--quant-train": bool(args.quant_train),
+            "--loss-impl": args.loss_impl != "fused",
+            "--ring-overlap": args.ring_overlap,
+            "--profile": bool(args.profile),
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if bad:
+            ap.error(f"--data-bench does not support {' '.join(bad)} "
+                     "(it measures the input pipeline, not the train step)")
+    elif args.data_workers:
+        ap.error("--data-workers applies to --data-bench only (the train "
+                 "bench generates batches on-device; the CLI train "
+                 "subcommand has its own --data-workers)")
     if args.steps_per_call < 1 or args.steps % args.steps_per_call:
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")
@@ -1302,6 +1379,8 @@ def main():
         emit_backend_error(args, err)
         return 1
 
+    if args.data_bench:
+        return run_data_bench_mode(args)
     if args.eval_throughput:
         return run_eval_throughput(args)
     if args.context:

@@ -65,6 +65,10 @@ BENCH_RECORD_FIELDS = frozenset(
         "group", "capacity",
         # shield deferral records
         "deferred", "signal", "child_pid", "child_stdout", "child_stderr",
+        # data-bench (stage + composed-pipeline records, data/data_bench.py)
+        "stage", "data_workers", "native_decode", "worker_scaling",
+        "synthetic_pairs_per_sec", "synthetic_ratio", "input_wait_frac",
+        "pipelined", "read_ahead", "zero_copy", "bound_stage",
     )
 )
 

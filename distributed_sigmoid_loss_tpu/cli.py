@@ -19,6 +19,12 @@ The subcommands tie the subsystems together:
   + LRU cache + retrieval index) on synthetic data; prints the ``stats()``
   snapshot (qps, latency percentiles, batch histogram, cache hit rate, compile
   count) as one JSON record. CPU-runnable — docs/SERVING.md.
+- ``data-bench`` — input-pipeline stage bench: shard read / decode / tokenize
+  / augment / host→device commit in isolation, plus the composed real-data
+  pipeline (read-ahead + fused batcher + prefetch) vs the synthetic loader,
+  as schema-validated JSON records with the ``synthetic_ratio`` acceptance
+  figure and a decode worker-scaling curve. CPU-runnable —
+  docs/PERF.md "Feeding the headline".
 - ``lint`` — graftlint: the repo-invariant AST linter plus the jaxpr
   collective/dtype auditor traced over the six real step configs on an
   emulated CPU mesh (exit 1 on findings, ``--json``, per-rule ``--disable``).
@@ -594,6 +600,15 @@ def cmd_train(args) -> int:
         print("--native-decode without --data-dir/--data-shards would be a "
               "silent no-op (synthetic data is not decoded)", file=sys.stderr)
         return 2
+    # 0 = auto (cpu_count minus the prefetch/main threads); the host worker
+    # pool for decode (file sources) / generation (native engine).
+    from distributed_sigmoid_loss_tpu.data.workers import resolve_data_workers
+
+    try:
+        data_workers = resolve_data_workers(args.data_workers)
+    except ValueError as e:
+        print(f"--data-workers: {e}", file=sys.stderr)
+        return 2
     # Resolved by the file-stream branch; read by the --eval-data holdout so
     # eval decode/tokenization matches training exactly.
     native_decode = False
@@ -618,6 +633,7 @@ def cmd_train(args) -> int:
             source = ImageTextFolder(
                 args.data_dir, cfg, args.batch, tokenize,
                 native_decode=native_decode,
+                data_workers=data_workers,
             )
         else:
             import glob as globmod
@@ -644,6 +660,7 @@ def cmd_train(args) -> int:
                 shard_index=pidx, num_shards=pcnt,
                 native_decode=native_decode,
                 shuffle_buffer=args.shuffle_buffer,
+                data_workers=data_workers,
             )
     elif args.native_data:
         from distributed_sigmoid_loss_tpu.data import (
@@ -654,7 +671,9 @@ def cmd_train(args) -> int:
         reason = "no C++ toolchain or prebuilt library"
         if native_available():
             try:
-                source = NativeSyntheticImageText(cfg, args.batch)
+                source = NativeSyntheticImageText(
+                    cfg, args.batch, num_threads=data_workers
+                )
             except (RuntimeError, OSError) as e:
                 # available() can't foresee every build failure (old compiler,
                 # read-only install dir); the flag promises a fallback either way.
@@ -806,15 +825,36 @@ def cmd_train(args) -> int:
             return global_batch_from_local(b, mesh, axis_name=batch_axes)
         return place_global(b)
 
-    def device_batches(skip: int = 0):
+    def host_batches(skip: int = 0):
         # The synthetic pipeline is deterministic per position: on resume, skip
         # the batches the checkpointed steps already consumed so the resumed run
         # sees the same stream an uninterrupted run would.
         if skip == 0:
-            yield place(first)
+            yield first
         for i, b in enumerate(data, start=1):
             if i >= skip:
-                yield place(b)
+                yield b
+
+    # Device feeding goes through data.prefetch: a worker thread keeps host
+    # fetch + decode + host->device commit one batch ahead of the step, and
+    # the stats object turns device starvation into a NUMBER — every train
+    # log line carries input_wait_frac (~0 = the host keeps up; positive =
+    # the fraction of wall time the device sat waiting on input).
+    from distributed_sigmoid_loss_tpu.data import PrefetchStats, prefetch as _prefetch
+
+    input_stats = PrefetchStats()
+
+    def device_batches(skip: int = 0):
+        return _prefetch(
+            host_batches(skip), mesh, size=2,
+            put=lambda b, m, a: place(b), stats=input_stats,
+        )
+
+    def log_metrics(step_i, m):
+        logger.log(step_i, {
+            **{k: float(v) for k, v in m.items()},
+            "input_wait_frac": input_stats.input_wait_frac(),
+        })
 
     eval_hook = None
     if args.eval_every:
@@ -900,12 +940,13 @@ def cmd_train(args) -> int:
         from distributed_sigmoid_loss_tpu.train import AsyncSaver
 
         saver_ctx = AsyncSaver() if args.async_checkpoint else contextlib.nullcontext()
+        stream = device_batches(skip)
         with PreemptionGuard() as guard, saver_ctx as saver:
             try:
                 state, report = train_resilient(
                     state,
                     step_fn,
-                    device_batches(skip),
+                    stream,
                     total_steps=args.steps,
                     ckpt_dir=args.ckpt_dir,
                     ckpt_every=args.ckpt_every,
@@ -917,15 +958,17 @@ def cmd_train(args) -> int:
                     # refuse (BEFORE any step runs) to train from all-zero
                     # params and overwrite --ckpt-dir with garbage.
                     require_restore=resuming,
-                    on_metrics=lambda i, m: logger.log(
-                        i, {k: float(v) for k, v in m.items()}
-                    ),
+                    on_metrics=log_metrics,
                     eval_every=args.eval_every,
                     on_eval=eval_hook,
                 )
             except RestoreRequiredError as e:
                 print(f"--ckpt-dir {args.ckpt_dir}: {e}", file=sys.stderr)
                 return 1
+            finally:
+                # Join the prefetch worker BEFORE anything else reads `data`:
+                # after close the source iterator has no concurrent reader.
+                stream.close()
         print(
             f"resilient loop: steps {report.start_step}->{report.final_step}, "
             f"checkpoints at {report.checkpoints}"
@@ -934,11 +977,15 @@ def cmd_train(args) -> int:
         )
     else:
         # 1-based step numbers, matching train_resilient's on_metrics contract.
-        for i, batch in zip(range(1, args.steps + 1), device_batches()):
-            state, metrics = step_fn(state, batch)
-            logger.log(i, {k: float(v) for k, v in metrics.items()})
-            if eval_hook is not None and i % args.eval_every == 0:
-                eval_hook(i, state)
+        stream = device_batches()
+        try:
+            for i, batch in zip(range(1, args.steps + 1), stream):
+                state, metrics = step_fn(state, batch)
+                log_metrics(i, metrics)
+                if eval_hook is not None and i % args.eval_every == 0:
+                    eval_hook(i, state)
+        finally:
+            stream.close()  # joins the worker; `data` is single-reader again
 
     # Zero-shot retrieval on a held-out synthetic batch (the model normalizes
     # its embeddings already).
@@ -1429,6 +1476,16 @@ def cmd_serve_bench(args) -> int:
     return 0
 
 
+def cmd_data_bench(args) -> int:
+    """Run the input-pipeline stage bench (data/data_bench.py) — the
+    CPU-runnable surface; ``bench.py --data-bench`` queues the same runner on
+    the chip host."""
+    _bootstrap_devices(args)
+    from distributed_sigmoid_loss_tpu.data.data_bench import run_data_bench
+
+    return run_data_bench(args)
+
+
 def cmd_lint(args) -> int:
     """Run graftlint: the repo-invariant AST linter plus (default) the jaxpr
     collective/dtype auditor over the six real step configs on an emulated
@@ -1624,6 +1681,10 @@ def main(argv=None) -> int:
                     help="use the C++ input-pipeline engine (native/dataloader.cc) "
                          "instead of the numpy pipeline; falls back with a notice "
                          "when no toolchain is available")
+    tr.add_argument("--data-workers", type=int, default=0, metavar="N",
+                    help="host worker threads for image decode / native "
+                         "generation (0 = auto: cpu_count minus the "
+                         "prefetch/main threads)")
     tr.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over dp (ZeRO-1) — fits "
                          "so400m-class towers in v5e HBM")
@@ -1817,6 +1878,22 @@ def main(argv=None) -> int:
     sb.add_argument("--cpu-devices", type=int, default=0,
                     help="emulate N CPU devices (pair with --mesh)")
 
+    db = sub.add_parser(
+        "data-bench",
+        help="input-pipeline stage bench: shard read / decode / tokenize / "
+             "augment / h2d commit in isolation + the composed real-data "
+             "pipeline vs the synthetic loader (schema-validated JSON "
+             "records; CPU-runnable) — docs/PERF.md 'Feeding the headline'",
+    )
+    from distributed_sigmoid_loss_tpu.data.data_bench import (
+        add_data_bench_args,
+    )
+
+    add_data_bench_args(db)
+    db.add_argument("--cpu-devices", type=int, default=0,
+                    help="emulate N CPU devices (the h2d/composed stages "
+                         "commit onto this mesh)")
+
     ln = sub.add_parser(
         "lint",
         help="graftlint: repo-invariant linter + jaxpr collective/dtype "
@@ -1852,6 +1929,7 @@ def main(argv=None) -> int:
         "tokenizer": cmd_tokenizer,
         "bench": lambda a: cmd_bench(a.rest),
         "serve-bench": cmd_serve_bench,
+        "data-bench": cmd_data_bench,
         "lint": cmd_lint,
     }
     return dispatch[args.cmd](args)

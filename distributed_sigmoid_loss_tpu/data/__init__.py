@@ -1,4 +1,5 @@
 from distributed_sigmoid_loss_tpu.data.loader import (  # noqa: F401
+    PrefetchStats,
     batch_shardings,
     global_batch_from_local,
     prefetch,
@@ -27,4 +28,8 @@ from distributed_sigmoid_loss_tpu.data.augment import (  # noqa: F401
     normalize,
     random_flip,
     random_resized_crop,
+)
+from distributed_sigmoid_loss_tpu.data.workers import (  # noqa: F401
+    default_data_workers,
+    resolve_data_workers,
 )

@@ -72,20 +72,27 @@ def _pair_key(name: str) -> tuple[str, str] | None:
 class _PairBatcher:
     """Accumulate (image_bytes, caption) pairs into static-shape batches.
 
-    Decode happens at flush time, a full batch at once: with
-    ``native_decode=True`` the libjpeg engine (``data/native_decode.py``) fans
-    the batch over threads off the GIL; otherwise each image goes through the
-    PIL path. Per-image decode-on-add would serialize the native path away.
+    Decode + tokenize happen at flush time (:meth:`assemble`), a full batch at
+    once: with ``native_decode=True`` the libjpeg engine
+    (``data/native_decode.py``) fans the batch over ``data_workers`` threads
+    off the GIL — the whole batch crosses the GIL ONCE per stage instead of
+    per image; otherwise each image goes through the PIL path. Per-image
+    decode-on-add would serialize the native path away.
+
+    :meth:`stage` / :meth:`assemble` are split so the pipelined shard reader
+    can run ``assemble`` on a worker thread while the tar stream keeps
+    staging the next batch's blobs.
     """
 
     def __init__(
         self, cfg, batch_size: int, tokenize: Callable, native_decode: bool = False,
-        keep_captions: bool = False,
+        keep_captions: bool = False, data_workers: int | None = None,
     ):
         self.cfg = cfg
         self.batch_size = batch_size
         self.tokenize = tokenize
         self.native_decode = native_decode
+        self.data_workers = data_workers
         # keep_captions adds the raw caption strings to each batch (a host-side
         # list, NOT device-transferable) — eval uses them as zero-shot class
         # names; pop the key before put_batch/device_put.
@@ -93,22 +100,28 @@ class _PairBatcher:
         self._blobs: list[bytes] = []
         self._texts: list[str] = []
 
-    def add(self, image_bytes: bytes, caption: str) -> dict | None:
+    def stage(self, image_bytes: bytes, caption: str) -> tuple[list, list] | None:
+        """Buffer one pair; on a full batch, hand back (blobs, texts) for
+        :meth:`assemble` and reset the buffers."""
         self._blobs.append(image_bytes)
         self._texts.append(caption)
         if len(self._blobs) < self.batch_size:
             return None
+        blobs, texts = self._blobs, self._texts
+        self._blobs, self._texts = [], []
+        return blobs, texts
+
+    def assemble(self, blobs: list, texts: list) -> dict:
+        """(blobs, texts) → the training batch dict: fused decode + tokenize."""
         size = self.cfg.vision.image_size
         if self.native_decode:
             from distributed_sigmoid_loss_tpu.data.native_decode import decode_batch
 
-            images = decode_batch(self._blobs, size)
+            images = decode_batch(blobs, size, threads=self.data_workers)
         else:
-            images = np.stack(
-                [decode_and_resize(b, size) for b in self._blobs]
-            )
+            images = np.stack([decode_and_resize(b, size) for b in blobs])
         tokens = np.asarray(
-            self.tokenize(self._texts, self.cfg.text.context_length), np.int32
+            self.tokenize(texts, self.cfg.text.context_length), np.int32
         )
         if tokens.min() < 0 or tokens.max() >= self.cfg.text.vocab_size:
             # Out-of-range ids reach nn.Embed as NaNs under jit (jnp.take fill
@@ -121,9 +134,14 @@ class _PairBatcher:
             )
         batch = {"images": images, "tokens": tokens}
         if self.keep_captions:
-            batch["captions"] = list(self._texts)
-        self._blobs, self._texts = [], []
+            batch["captions"] = list(texts)
         return batch
+
+    def add(self, image_bytes: bytes, caption: str) -> dict | None:
+        job = self.stage(image_bytes, caption)
+        if job is None:
+            return None
+        return self.assemble(*job)
 
 
 class ImageTextFolder:
@@ -144,6 +162,7 @@ class ImageTextFolder:
         seed: int | None = 0,
         native_decode: bool = False,
         keep_captions: bool = False,
+        data_workers: int | None = None,
     ):
         self.root = root
         self.keep_captions = keep_captions
@@ -152,6 +171,7 @@ class ImageTextFolder:
         self.tokenize = tokenize
         self.seed = seed
         self.native_decode = native_decode
+        self.data_workers = data_workers
         pairs: dict[str, dict] = {}
         for name in sorted(os.listdir(root)):
             key = _pair_key(name)
@@ -180,6 +200,7 @@ class ImageTextFolder:
             batcher = _PairBatcher(
                 self.cfg, self.batch_size, self.tokenize, self.native_decode,
                 keep_captions=self.keep_captions,
+                data_workers=self.data_workers,
             )
             for i in order:
                 item = self.items[i]
@@ -203,6 +224,16 @@ class ImageTextShards:
     ``shuffle_buffer`` (webdataset's sample-shuffle: a reservoir of that many
     pairs, emit a random one as each new pair streams in — memory stays
     O(buffer + batch) and the stream is deterministic given ``seed``).
+
+    Overlap (both on by default; the emitted STREAM is identical either way,
+    so the flags are perf knobs, not semantics):
+
+    - ``read_ahead`` — the NEXT shard's members are fetched by a background
+      reader while the current shard's pairs decode, hiding shard-read
+      latency behind decode (memory goes O(batch) → O(shard)).
+    - ``pipelined`` — each full batch's decode+tokenize flush runs on a
+      worker thread (one batch in flight) while the tar stream stages the
+      next batch's blobs, so batch assembly overlaps shard reading.
     """
 
     def __init__(
@@ -217,6 +248,9 @@ class ImageTextShards:
         native_decode: bool = False,
         shuffle_buffer: int = 0,
         keep_captions: bool = False,
+        data_workers: int | None = None,
+        read_ahead: bool = True,
+        pipelined: bool = True,
     ):
         self.keep_captions = keep_captions
         if not shards:
@@ -234,6 +268,9 @@ class ImageTextShards:
         self.tokenize = tokenize
         self.seed = seed
         self.native_decode = native_decode
+        self.data_workers = data_workers
+        self.read_ahead = read_ahead
+        self.pipelined = pipelined
         if shuffle_buffer < 0:
             raise ValueError(f"shuffle_buffer must be >= 0, got {shuffle_buffer}")
         if shuffle_buffer and seed is None:
@@ -242,26 +279,51 @@ class ImageTextShards:
             raise ValueError("shuffle_buffer requires a seed")
         self.shuffle_buffer = shuffle_buffer
 
+    def _shard_pairs(self, path: str) -> Iterator[tuple[bytes, str]]:
+        """(image_bytes, caption) pairs of ONE shard, tar order."""
+        with tarfile.open(path, "r") as tf:
+            pending: dict[str, dict] = {}
+            for member in tf:
+                if not member.isfile():
+                    continue
+                key = _pair_key(os.path.basename(member.name))
+                if key is None:
+                    continue
+                base, kind = key
+                buf = tf.extractfile(member)
+                if buf is None:
+                    continue
+                entry = pending.setdefault(base, {})
+                entry[kind] = buf.read()
+                if "image" in entry and "text" in entry:
+                    del pending[base]
+                    yield entry["image"], entry["text"].decode("utf-8").strip()
+
     def _pairs(self, order) -> Iterator[tuple[bytes, str]]:
-        """(image_bytes, caption) pairs across the epoch's shards, tar order."""
-        for si in order:
-            with tarfile.open(self.shards[si], "r") as tf:
-                pending: dict[str, dict] = {}
-                for member in tf:
-                    if not member.isfile():
-                        continue
-                    key = _pair_key(os.path.basename(member.name))
-                    if key is None:
-                        continue
-                    base, kind = key
-                    buf = tf.extractfile(member)
-                    if buf is None:
-                        continue
-                    entry = pending.setdefault(base, {})
-                    entry[kind] = buf.read()
-                    if "image" in entry and "text" in entry:
-                        del pending[base]
-                        yield entry["image"], entry["text"].decode("utf-8").strip()
+        """(image_bytes, caption) pairs across the epoch's shards, tar order.
+
+        With ``read_ahead`` a single background reader fetches shard k+1's
+        members while shard k's pairs are consumed (decoded) — the emitted
+        sequence is exactly the serial one, only the blob IO overlaps.
+        """
+        if not self.read_ahead or len(order) <= 1:
+            for si in order:
+                yield from self._shard_pairs(self.shards[si])
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        def read(si) -> list[tuple[bytes, str]]:
+            return list(self._shard_pairs(self.shards[si]))
+
+        # Exactly one shard in flight: the executor exit joins the reader, so
+        # an abandoned epoch (generator close) never leaks the thread.
+        with ThreadPoolExecutor(1, thread_name_prefix="dsl-shard-read") as ex:
+            fut = ex.submit(read, order[0])
+            for k in range(len(order)):
+                pairs = fut.result()
+                if k + 1 < len(order):
+                    fut = ex.submit(read, order[k + 1])
+                yield from pairs
 
     def _shuffled(self, pairs, rng) -> Iterator[tuple[bytes, str]]:
         """Bounded reservoir shuffle (webdataset-style): hold ``shuffle_buffer``
@@ -281,6 +343,33 @@ class ImageTextShards:
             held.pop()
             yield last
 
+    def _epoch_batches(self, pairs, batcher) -> Iterator[dict]:
+        """Batches of one epoch. Serial mode flushes inline; pipelined mode
+        keeps ONE batch's decode+tokenize in flight on a worker thread while
+        the pair stream stages the next batch — same batches, same order."""
+        if not self.pipelined:
+            for image_bytes, caption in pairs:
+                batch = batcher.add(image_bytes, caption)
+                if batch is not None:
+                    yield batch
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        pending = None
+        # Executor exit joins the in-flight flush (one bounded batch), so an
+        # abandoned stream (break / GC) never leaks the assembly thread.
+        with ThreadPoolExecutor(1, thread_name_prefix="dsl-batch") as ex:
+            for image_bytes, caption in pairs:
+                job = batcher.stage(image_bytes, caption)
+                if job is None:
+                    continue
+                fut = ex.submit(batcher.assemble, *job)
+                if pending is not None:
+                    yield pending.result()
+                pending = fut
+            if pending is not None:
+                yield pending.result()
+
     def __iter__(self) -> Iterator[dict]:
         rng = np.random.default_rng(self.seed) if self.seed is not None else None
         while True:
@@ -291,15 +380,14 @@ class ImageTextShards:
             batcher = _PairBatcher(
                 self.cfg, self.batch_size, self.tokenize, self.native_decode,
                 keep_captions=self.keep_captions,
+                data_workers=self.data_workers,
             )
             pairs = self._pairs(order)
             if self.shuffle_buffer:
                 pairs = self._shuffled(pairs, rng)
-            for image_bytes, caption in pairs:
-                batch = batcher.add(image_bytes, caption)
-                if batch is not None:
-                    yielded = True
-                    yield batch
+            for batch in self._epoch_batches(pairs, batcher):
+                yielded = True
+                yield batch
             if not yielded:
                 # Mirror ImageTextFolder's too-few-pairs ValueError (which can
                 # check up front); here pair counts are only known after a full

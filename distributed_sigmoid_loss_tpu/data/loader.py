@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, Iterable, Iterator
+import time
+from typing import Any, Callable, Iterable, Iterator
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -31,7 +32,62 @@ __all__ = [
     "put_batch",
     "global_batch_from_local",
     "prefetch",
+    "PrefetchStats",
 ]
+
+
+class PrefetchStats:
+    """Starvation counters for one :func:`prefetch` stream.
+
+    The overlap question — "is the device waiting on the host?" — must be a
+    measured number, not a guess from throughput deltas. The producer thread
+    and the consumer each record how long they spent blocked on the queue:
+
+    - ``consumer_wait_s`` — time the consumer spent blocked in ``get`` with
+      the queue empty. This is device starvation: the step loop had nothing
+      to run.
+    - ``producer_wait_s`` — time the worker spent blocked in ``put`` with the
+      queue full. This is the healthy direction (the host is ahead).
+    - ``produced`` / ``consumed`` — batch counters (monotonic).
+    - ``queue_depth`` — queue occupancy observed at the last consumer get.
+
+    ``input_wait_frac`` is the headline ratio: consumer wait over wall time
+    since the first consumer request. ~0 means prefetch keeps the device fed;
+    anything materially positive is host-bound feeding and names the gap the
+    ``data-bench`` stage table attributes.
+
+    Counter updates are single-writer per field (producer writes
+    producer-side fields, consumer the consumer-side ones), so reads need no
+    lock — snapshots are approximate by one batch at worst.
+    """
+
+    def __init__(self):
+        self.produced = 0
+        self.consumed = 0
+        self.producer_wait_s = 0.0
+        self.consumer_wait_s = 0.0
+        self.queue_depth = 0
+        self._t_first_get: float | None = None
+
+    def input_wait_frac(self) -> float:
+        """Fraction of consumer wall time spent starved (0.0 before the first
+        get — a log line must never divide by zero)."""
+        if self._t_first_get is None:
+            return 0.0
+        elapsed = time.perf_counter() - self._t_first_get
+        if elapsed <= 0.0:
+            return 0.0
+        return min(1.0, self.consumer_wait_s / elapsed)
+
+    def snapshot(self) -> dict:
+        return {
+            "produced": self.produced,
+            "consumed": self.consumed,
+            "producer_wait_s": round(self.producer_wait_s, 4),
+            "consumer_wait_s": round(self.consumer_wait_s, 4),
+            "queue_depth": self.queue_depth,
+            "input_wait_frac": round(self.input_wait_frac(), 4),
+        }
 
 
 def batch_shardings(mesh: Mesh, batch: Any, axis_name: str = data_axis) -> Any:
@@ -65,6 +121,8 @@ def prefetch(
     size: int = 2,
     axis_name: str = data_axis,
     multihost: bool = False,
+    put: Callable[[Any, Mesh, Any], Any] | None = None,
+    stats: PrefetchStats | None = None,
 ) -> Iterator[Any]:
     """Iterate ``it``, keeping ``size`` device-resident batches in flight.
 
@@ -72,19 +130,35 @@ def prefetch(
     transfer; consumers receive committed global arrays. Exceptions from the
     source iterator propagate to the consumer at the matching position.
     Abandoning the iterator early (``break``, exception, garbage collection)
-    closes it: the worker stops and the queued device batches are released
-    rather than pinned in HBM for the life of the process.
+    closes it: the worker is woken, JOINED (bounded), and the queued device
+    batches are dropped rather than pinned in HBM for the life of the
+    process — after close the source iterator has no concurrent reader, so
+    the caller may keep using it single-threaded.
+
+    ``put`` overrides the host→device commit (default
+    :func:`put_batch` / :func:`global_batch_from_local` per ``multihost``) —
+    the CLI threads its multi-process slice-and-place through this. ``stats``
+    (a :class:`PrefetchStats`) makes the overlap observable: queue depth,
+    producer/consumer blocked time, and the ``input_wait_frac`` starvation
+    ratio the train loop logs.
     """
     q: queue.Queue = queue.Queue(maxsize=size)
     _END = object()
     stop = threading.Event()
 
-    put = global_batch_from_local if multihost else put_batch
+    if put is None:
+        put = global_batch_from_local if multihost else put_batch
 
     def enqueue(item) -> bool:
+        t0 = time.perf_counter() if stats is not None else 0.0
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
+                if stats is not None:
+                    # Time from the put REQUEST to its success: a put that
+                    # blocked inside its first timeout window counts too. An
+                    # unblocked put adds ~µs — noise, and the healthy sign.
+                    stats.producer_wait_s += time.perf_counter() - t0
                 return True
             except queue.Full:
                 continue
@@ -95,24 +169,46 @@ def prefetch(
             for batch in it:
                 if not enqueue(put(batch, mesh, axis_name)):
                     return
+                if stats is not None:
+                    stats.produced += 1
         except BaseException as e:  # noqa: BLE001 — relayed to the consumer
             enqueue(e)
             return
         enqueue(_END)
 
-    threading.Thread(target=worker, daemon=True).start()
+    thread = threading.Thread(
+        target=worker, daemon=True, name="dsl-prefetch"
+    )
+    thread.start()
     try:
         while True:
-            item = q.get()
+            if stats is not None:
+                now = time.perf_counter()
+                if stats._t_first_get is None:
+                    stats._t_first_get = now
+                stats.queue_depth = q.qsize()
+                item = q.get()
+                stats.consumer_wait_s += time.perf_counter() - now
+            else:
+                item = q.get()
             if item is _END:
                 return
             if isinstance(item, BaseException):
                 raise item
+            if stats is not None:
+                stats.consumed += 1
             yield item
     finally:
-        # Generator closed (early break / GC): unblock the worker and drop any
-        # queued device arrays.
+        # Generator closed (early break / GC): unblock the worker, then JOIN
+        # it before draining — a worker still blocked inside ``q.put`` could
+        # otherwise deliver one more (stale) batch into the drained queue,
+        # where it outlives the generator pinned in HBM. The worker's put
+        # loop polls ``stop`` every 0.1 s, so the bounded join only expires
+        # if the SOURCE iterator itself is wedged mid-``next`` — in which
+        # case the drain below still runs and the daemon thread cannot
+        # enqueue (stop is set).
         stop.set()
+        thread.join(timeout=5.0)
         while not q.empty():
             try:
                 q.get_nowait()

@@ -28,6 +28,7 @@ import warnings
 import numpy as np
 
 from distributed_sigmoid_loss_tpu.data.native_loader import build_shared_lib
+from distributed_sigmoid_loss_tpu.data.workers import default_data_workers
 
 __all__ = ["native_decode_available", "decode_batch", "default_decode_threads"]
 
@@ -35,9 +36,10 @@ __all__ = ["native_decode_available", "decode_batch", "default_decode_threads"]
 def default_decode_threads() -> int:
     """Per-flush thread cap when the caller doesn't pass ``threads``.
 
-    ``DSL_DECODE_THREADS`` overrides; the default halves ``cpu_count`` (min 1)
-    so two concurrent loaders (e.g. train + eval iterators flushing at once)
-    don't oversubscribe the host — each flush spawns raw ``std::thread``s.
+    ``DSL_DECODE_THREADS`` overrides; otherwise the shared host-worker
+    resolver (``data/workers.py``): cpu_count minus the prefetch/main
+    threads, min 1 — each flush spawns raw ``std::thread``s next to the
+    pipeline's own threads, so those reserved cores must not be claimed.
     """
     env = os.environ.get("DSL_DECODE_THREADS")
     if env:
@@ -45,7 +47,7 @@ def default_decode_threads() -> int:
             return max(1, int(env))
         except ValueError:
             warnings.warn(f"DSL_DECODE_THREADS={env!r} is not an int; ignoring")
-    return max(1, (os.cpu_count() or 1) // 2)
+    return default_data_workers()
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),

@@ -27,6 +27,7 @@ from typing import Iterator
 
 import numpy as np
 
+from distributed_sigmoid_loss_tpu.data.workers import default_data_workers
 from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
 
 __all__ = [
@@ -125,6 +126,21 @@ def load_library():
         lib.dsl_pipeline_stop.argtypes = [ctypes.c_void_p]
         lib.dsl_pipeline_destroy.restype = None
         lib.dsl_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        try:
+            # Zero-copy surface (added with the pipelined input layer); a
+            # prebuilt .so from before it simply lacks the symbols — the
+            # copying path keeps working and batches(zero_copy=True) raises
+            # a clear error instead of an AttributeError mid-stream.
+            lib.dsl_pipeline_acquire.restype = ctypes.c_int64
+            lib.dsl_pipeline_acquire.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ]
+            lib.dsl_pipeline_release.restype = None
+            lib.dsl_pipeline_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -161,15 +177,21 @@ class NativeSyntheticImageText:
         global_batch: int,
         image_seed: int = 42,
         text_seed: int = 40,
-        num_threads: int = 4,
+        num_threads: int | None = None,
         queue_depth: int = 4,
     ):
         self.cfg = cfg
         self.global_batch = global_batch
+        # None = auto: cpu_count minus the prefetch/main threads (the old
+        # static 4 oversubscribed small hosts and under-fed big ones).
+        self.num_threads = (
+            num_threads if num_threads else default_data_workers()
+        )
         self._lib = load_library()
         self._handle = self._lib.dsl_pipeline_create(
             global_batch, cfg.vision.image_size, cfg.text.context_length,
-            cfg.text.vocab_size, image_seed, text_seed, num_threads, queue_depth,
+            cfg.text.vocab_size, image_seed, text_seed, self.num_threads,
+            queue_depth,
         )
         if not self._handle:
             raise ValueError(
@@ -202,6 +224,63 @@ class NativeSyntheticImageText:
             if n < 0:  # stopped under our feet
                 return
             yield {"images": images, "tokens": tokens}
+
+    def batches(self, zero_copy: bool = False) -> Iterator[dict]:
+        """Batch stream; ``zero_copy=True`` hands out numpy VIEWS of the C++
+        ring slots instead of copying into fresh arrays.
+
+        The views are valid only until the next iteration (or generator
+        close) — the slot is handed back to the worker pool then. The
+        intended consumer commits the batch inside the loop body (e.g.
+        ``data.loader.prefetch``'s worker calling ``put_batch``: the
+        host→device transfer reads the ring buffer directly and the
+        intermediate numpy copy disappears). Anyone keeping host arrays past
+        one iteration must ``np.copy`` them.
+
+        Safe on EVERY backend: jax's CPU client zero-copy-aliases 64-byte-
+        aligned host buffers in ``device_put`` (which would leave a live
+        "device" array pointing into a recycled slot), so the C++ ring
+        deliberately mis-aligns slot payloads (``native/dataloader.cc``
+        Slot) — the CPU backend is forced onto its copying path, accelerator
+        backends DMA-copy regardless, and "zero-copy" keeps meaning what it
+        says: zero HOST-side copies.
+
+        Raises RuntimeError when the loaded library predates the zero-copy
+        symbols (stale prebuilt .so on a compiler-less host).
+        """
+        if not zero_copy:
+            yield from self
+            return
+        if not hasattr(self._lib, "dsl_pipeline_acquire"):
+            raise RuntimeError(
+                "zero-copy needs dsl_pipeline_acquire/release: the loaded "
+                "libdsl_data.so predates them — rebuild native/ (make -C "
+                "native) or drop zero_copy"
+            )
+        img_p = ctypes.POINTER(ctypes.c_float)()
+        tok_p = ctypes.POINTER(ctypes.c_int32)()
+        while True:
+            with self._iter_lock:
+                if self._closed:
+                    return
+                handle = self._handle
+                n = self._lib.dsl_pipeline_acquire(
+                    handle, ctypes.byref(img_p), ctypes.byref(tok_p)
+                )
+            if n < 0:  # stopped under our feet
+                return
+            try:
+                images = np.ctypeslib.as_array(img_p, shape=self._image_shape)
+                tokens = np.ctypeslib.as_array(tok_p, shape=self._token_shape)
+                yield {"images": images, "tokens": tokens}
+            finally:
+                # Deliberately NOT under _iter_lock: a concurrent close() may
+                # already be blocked inside dsl_pipeline_destroy (holding
+                # _iter_lock) waiting for exactly this release — taking the
+                # lock here would deadlock. The engine cannot be freed while
+                # the slot is held (destroy waits for consumers_inside == 0),
+                # so the raw call is safe.
+                self._lib.dsl_pipeline_release(handle, n)
 
     def close(self):
         with self._close_lock:

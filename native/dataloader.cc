@@ -63,8 +63,19 @@ struct Xoshiro {
 };
 
 struct Slot {
+  // Backing storage is one element larger than the payload and the payload
+  // starts at +1: operator new returns >= 16-byte-aligned memory, so
+  // data()+1 is ≡ 4 (mod 16) — NEVER 64-byte aligned. This is deliberate:
+  // jax's CPU PJRT client zero-copy-ALIASES 64-byte-aligned host buffers in
+  // device_put, and an aliased ring slot would be overwritten by a worker
+  // the moment the consumer releases it, corrupting a live "device" array
+  // (CPU-emulation only; accelerator backends DMA-copy regardless). The
+  // guaranteed misalignment forces the CPU backend onto its copying path,
+  // making the zero-copy acquire/release handoff safe on every backend.
   std::vector<float> images;
   std::vector<int32_t> tokens;
+  float* img() { return images.data() + 1; }
+  int32_t* tok() { return tokens.data() + 1; }
   // Batch index whose data this slot currently holds (-1 = none), and the last
   // batch index the consumer finished with (slot reusable for last + depth).
   int64_t ready = -1;
@@ -91,7 +102,7 @@ struct Pipeline {
     // Counter-based seeding: batch content depends only on (seed, n).
     uint64_t is = image_seed ^ (0xA0761D64ULL + (uint64_t)n * 0x9E3779B97F4A7C15ULL);
     Xoshiro irng(is);
-    float* img = slot.images.data();
+    float* img = slot.img();
     const size_t ne = image_elems;
     // Box-Muller in pairs: standard-normal images, like numpy standard_normal.
     for (size_t i = 0; i + 1 < ne; i += 2) {
@@ -109,7 +120,7 @@ struct Pipeline {
     }
     uint64_t ts = text_seed ^ (0x7F4A7C15ULL + (uint64_t)n * 0xBF58476D1CE4E5B9ULL);
     Xoshiro trng(ts);
-    int32_t* tok = slot.tokens.data();
+    int32_t* tok = slot.tok();
     for (size_t i = 0; i < token_elems; ++i) {
       // Rejection-free modulo is fine here: vocab << 2^64, bias is ~2^-50.
       tok[i] = (int32_t)(trng.next() % (uint64_t)vocab);
@@ -157,8 +168,8 @@ Pipeline* dsl_pipeline_create(int64_t batch, int64_t image_size, int64_t context
   p->token_elems = (size_t)batch * context;
   p->slots.resize(depth);
   for (int i = 0; i < depth; ++i) {
-    p->slots[i].images.resize(p->image_elems);
-    p->slots[i].tokens.resize(p->token_elems);
+    p->slots[i].images.resize(p->image_elems + 1);
+    p->slots[i].tokens.resize(p->token_elems + 1);
     p->slots[i].last_consumed = (int64_t)i - depth;
   }
   for (int i = 0; i < threads; ++i)
@@ -185,8 +196,8 @@ int64_t dsl_pipeline_next(Pipeline* p, float* images, int32_t* tokens) {
       return -1;
     }
   }
-  std::memcpy(images, slot->images.data(), p->image_elems * sizeof(float));
-  std::memcpy(tokens, slot->tokens.data(), p->token_elems * sizeof(int32_t));
+  std::memcpy(images, slot->img(), p->image_elems * sizeof(float));
+  std::memcpy(tokens, slot->tok(), p->token_elems * sizeof(int32_t));
   {
     std::lock_guard<std::mutex> lk(p->mu);
     slot->ready = -1;
@@ -197,6 +208,43 @@ int64_t dsl_pipeline_next(Pipeline* p, float* images, int32_t* tokens) {
     p->idle.notify_all();
   }
   return n;
+}
+
+// Zero-copy variant of dsl_pipeline_next: exposes the ring slot's own buffers
+// instead of memcpying into caller storage. Returns the batch index and sets
+// *images/*tokens to the slot's data, which stays valid — and is NOT reused by
+// any worker — until dsl_pipeline_release(p, n) hands the slot back. Strict
+// index order, one outstanding acquisition per consumer; the consumer counts
+// as "inside" until release, so destroy() waits for it (never free buffers a
+// caller still views). Returns -1 after stop/destroy began.
+int64_t dsl_pipeline_acquire(Pipeline* p, float** images, int32_t** tokens) {
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (p->stopping) return -1;
+  ++p->consumers_inside;
+  const int64_t n = p->next_consume;
+  Slot* slot = &p->slots[n % p->depth];
+  p->slot_ready.wait(lk, [&] { return p->stopping || slot->ready == n; });
+  if (p->stopping) {
+    --p->consumers_inside;
+    p->idle.notify_all();
+    return -1;
+  }
+  *images = slot->img();
+  *tokens = slot->tok();
+  p->next_consume = n + 1;
+  return n;
+}
+
+// Hands slot n back to the worker pool after a zero-copy acquire; the
+// caller's pointers are dead past this call.
+void dsl_pipeline_release(Pipeline* p, int64_t n) {
+  std::lock_guard<std::mutex> lk(p->mu);
+  Slot& slot = p->slots[n % p->depth];
+  slot.ready = -1;
+  slot.last_consumed = n;
+  p->slot_freed.notify_all();
+  --p->consumers_inside;
+  p->idle.notify_all();
 }
 
 // Wakes every blocked consumer/worker (they return -1 / exit) without freeing

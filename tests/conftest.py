@@ -52,6 +52,7 @@ _STANDARD_MODULES = {
     "test_compat",
     "test_contrastive",
     "test_core_loss",
+    "test_data_pipeline",
     "test_distributed_parity",
     "test_pipeline",
     "test_serve",

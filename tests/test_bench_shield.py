@@ -119,6 +119,9 @@ def _bench_args(**overrides):
         eval_throughput=False, quant="", use_pallas=False, variant="ring",
         loss_family="sigmoid", precision="default", zero1=False,
         no_text_remat=False, scan_layers=False, steps_per_call=1,
+        # round-8 data-bench mode: jits the augment/commit programs (not in
+        # the headline warm cache), so it shields.
+        data_bench=False,
     )
     defaults.update(overrides)
     return argparse.Namespace(**defaults)

@@ -1,0 +1,368 @@
+"""The pipelined input layer (ISSUE 5): read-ahead shard streaming, the fused
+decode+tokenize batcher, the zero-copy native-ring handoff, prefetch
+starvation counters (``input_wait_frac``), worker auto-scaling, and the
+``data-bench`` record contract.
+
+Contracts pinned here:
+
+- overlap never changes the stream: read-ahead + pipelined assembly emit the
+  EXACT batches of the serial reader (ordering/determinism);
+- the zero-copy ring path is bit-identical to the copying path, standalone
+  AND through ``prefetch``'s device commit;
+- the starvation counters are monotonic, read ~0 when the producer keeps
+  ahead, and go positive under a throttled producer — the number the train
+  loop logs as ``input_wait_frac``;
+- ``prefetch`` joins its worker on close (no stale batch outlives the
+  generator, the source iterator is single-reader again);
+- every ``data-bench`` record validates against BENCH_RECORD_FIELDS.
+"""
+
+import argparse
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import write_tar_shard
+from distributed_sigmoid_loss_tpu.data.files import ImageTextShards
+from distributed_sigmoid_loss_tpu.data.loader import (
+    PrefetchStats,
+    prefetch,
+    put_batch,
+)
+from distributed_sigmoid_loss_tpu.data.tokenizer import ByteTokenizer
+from distributed_sigmoid_loss_tpu.data.workers import (
+    default_data_workers,
+    resolve_data_workers,
+)
+from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+CFG = SigLIPConfig.tiny_test()
+
+
+def _tokenize(texts, length):
+    # The CLI's vocab-fold rule: byte ids modulo the tiny test vocab.
+    return np.asarray(ByteTokenizer()(texts, length)) % CFG.text.vocab_size
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory):
+    td = tmp_path_factory.mktemp("shards")
+    rng = np.random.default_rng(0)
+    for s in range(3):
+        write_tar_shard(
+            td / f"s{s:03d}.tar",
+            [
+                (
+                    f"p{s}-{i}",
+                    rng.integers(0, 255, (20, 24, 3), dtype=np.uint8),
+                    f"caption {s} {i}",
+                )
+                for i in range(10)
+            ],
+            fmt="JPEG",
+            quality=90,
+        )
+    return [str(td / f"s{s:03d}.tar") for s in range(3)]
+
+
+def _take(src, n):
+    it = iter(src)
+    try:
+        return [next(it) for _ in range(n)]
+    finally:
+        it.close()
+
+
+@pytest.mark.parametrize(
+    "read_ahead,pipelined",
+    [(True, False), (False, True), (True, True)],
+    ids=["read-ahead", "pipelined", "both"],
+)
+def test_overlapped_stream_identical_to_serial(shard_dir, read_ahead, pipelined):
+    """Read-ahead and the fused worker batcher are pure perf knobs: batches,
+    order, and shuffle determinism are exactly the serial reader's."""
+    kw = dict(seed=3, shuffle_buffer=4)
+    serial = _take(
+        ImageTextShards(
+            shard_dir, CFG, 8, _tokenize, read_ahead=False, pipelined=False,
+            **kw,
+        ),
+        6,  # > one epoch: crosses shard AND epoch boundaries
+    )
+    overlapped = _take(
+        ImageTextShards(
+            shard_dir, CFG, 8, _tokenize, read_ahead=read_ahead,
+            pipelined=pipelined, **kw,
+        ),
+        6,
+    )
+    for a, b in zip(serial, overlapped):
+        np.testing.assert_array_equal(a["images"], b["images"])
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+
+
+def test_overlapped_stream_leaks_no_threads(shard_dir):
+    src = ImageTextShards(shard_dir, CFG, 8, _tokenize, seed=0)
+    _take(src, 2)  # abandon mid-epoch
+    time.sleep(0.2)
+    leaked = [t.name for t in threading.enumerate() if t.name.startswith("dsl-")]
+    assert not leaked, f"input-pipeline threads outlived the stream: {leaked}"
+
+
+# --- zero-copy native ring handoff ------------------------------------------
+
+_native = pytest.importorskip(
+    "distributed_sigmoid_loss_tpu.data.native_loader"
+)
+needs_native = pytest.mark.skipif(
+    not _native.native_available(),
+    reason="no C++ toolchain or prebuilt libdsl_data.so",
+)
+
+
+@needs_native
+def test_zero_copy_bit_identical_to_copy_path():
+    from distributed_sigmoid_loss_tpu.data.native_loader import (
+        NativeSyntheticImageText,
+    )
+
+    with NativeSyntheticImageText(CFG, 8, num_threads=2) as a:
+        ref = [
+            {k: v.copy() for k, v in b.items()}
+            for b, _ in zip(iter(a), range(4))
+        ]
+    with NativeSyntheticImageText(CFG, 8, num_threads=2) as b:
+        it = b.batches(zero_copy=True)
+        for r, _ in zip(ref, range(4)):
+            got = next(it)
+            # The ring guarantees mis-aligned slot payloads: jax's CPU
+            # backend zero-copy-aliases 64-byte-aligned buffers in
+            # device_put, which would dangle into the recycled slot —
+            # the deliberate misalignment forces its copying path.
+            for k in ("images", "tokens"):
+                assert got[k].ctypes.data % 64 != 0, f"{k} slot 64-aligned"
+            # Copy at comparison time: the views die at the next iteration.
+            np.testing.assert_array_equal(r["images"], np.array(got["images"]))
+            np.testing.assert_array_equal(r["tokens"], np.array(got["tokens"]))
+        it.close()
+
+
+@needs_native
+def test_zero_copy_through_prefetch_matches_copy_path():
+    """The intended composition: ring-slot views committed straight to the
+    device by prefetch's put_batch — the device arrays must equal the copy
+    path's (catches any premature slot reuse / aliasing)."""
+    import jax
+
+    from distributed_sigmoid_loss_tpu.data.native_loader import (
+        NativeSyntheticImageText,
+    )
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(4)
+    n = 4
+
+    def run(zero_copy):
+        out = []
+        with NativeSyntheticImageText(CFG, 8, num_threads=2) as ds:
+            stream = prefetch(ds.batches(zero_copy=zero_copy), mesh, size=2)
+            try:
+                for b, _ in zip(stream, range(n)):
+                    out.append(jax.tree.map(np.asarray, b))
+            finally:
+                stream.close()
+        return out
+
+    for a, b in zip(run(False), run(True)):
+        np.testing.assert_array_equal(a["images"], b["images"])
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+
+
+# --- prefetch starvation counters -------------------------------------------
+
+
+def _host_batches(n, rows=8, delay=0.0):
+    for i in range(n):
+        if delay:
+            time.sleep(delay)
+        yield {"x": np.full((rows, 4), i, np.float32)}
+
+
+def _mesh():
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+    return make_mesh()
+
+
+def test_stats_near_zero_when_producer_keeps_ahead():
+    stats = PrefetchStats()
+    stream = prefetch(_host_batches(12), _mesh(), size=4, stats=stats)
+    try:
+        seen_consumed = 0
+        for b, _ in zip(stream, range(10)):
+            time.sleep(0.02)  # slow consumer: the producer stays ahead
+            assert stats.consumed >= seen_consumed  # monotonic
+            seen_consumed = stats.consumed
+    finally:
+        stream.close()
+    snap = stats.snapshot()
+    assert snap["produced"] >= snap["consumed"] >= 10
+    # The producer outruns the consumer: starvation reads ~0 and the
+    # producer is the one that spent real time blocked on a full queue.
+    assert snap["input_wait_frac"] < 0.2, snap
+    assert snap["producer_wait_s"] > 0.01, snap
+
+
+def test_stats_positive_under_throttled_producer():
+    stats = PrefetchStats()
+    stream = prefetch(
+        _host_batches(8, delay=0.05), _mesh(), size=2, stats=stats
+    )
+    try:
+        for _ in zip(stream, range(6)):
+            pass  # consumer as fast as it can go: starved every batch
+    finally:
+        stream.close()
+    snap = stats.snapshot()
+    assert snap["input_wait_frac"] > 0.3, snap
+    assert snap["consumer_wait_s"] > 0.0, snap
+
+
+def test_prefetch_close_joins_worker_and_releases_source():
+    """After close: the worker thread is gone (no stale batch can land in the
+    drained queue) and the source iterator is single-reader again."""
+    produced = []
+
+    def source():
+        for i in range(100):
+            produced.append(i)
+            yield {"x": np.full((8, 2), i, np.float32)}
+
+    src = source()
+    stream = prefetch(src, _mesh(), size=2)
+    next(stream)
+    stream.close()
+    assert not [
+        t for t in threading.enumerate() if t.name == "dsl-prefetch"
+    ], "prefetch worker not joined on close"
+    n_after_close = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n_after_close, "worker kept pulling after close"
+    next(src)  # the caller owns the iterator again
+    assert len(produced) == n_after_close + 1
+
+
+def test_prefetch_relays_source_exception_at_position():
+    class Boom(RuntimeError):
+        pass
+
+    def source():
+        yield {"x": np.zeros((8, 2), np.float32)}
+        raise Boom("decode failed")
+
+    stream = prefetch(source(), _mesh(), size=2, stats=PrefetchStats())
+    next(stream)
+    with pytest.raises(Boom):
+        next(stream)
+
+
+# --- worker auto-scaling -----------------------------------------------------
+
+
+def test_default_data_workers_resolution(monkeypatch):
+    monkeypatch.delenv("DSL_DATA_WORKERS", raising=False)
+    auto = default_data_workers()
+    assert auto >= 1
+    monkeypatch.setenv("DSL_DATA_WORKERS", "6")
+    assert default_data_workers() == 6
+    assert resolve_data_workers(0) == 6  # 0 = auto (env-overridden here)
+    assert resolve_data_workers(None) == 6
+    assert resolve_data_workers(3) == 3  # explicit wins
+    with pytest.raises(ValueError):
+        resolve_data_workers(-2)
+
+
+@needs_native
+def test_native_loader_auto_threads(monkeypatch):
+    from distributed_sigmoid_loss_tpu.data.native_loader import (
+        NativeSyntheticImageText,
+    )
+
+    monkeypatch.setenv("DSL_DATA_WORKERS", "3")
+    with NativeSyntheticImageText(CFG, 8) as ds:
+        assert ds.num_threads == 3  # derived, not the old static 4
+
+
+# --- data-bench record contract ---------------------------------------------
+
+
+def test_data_bench_records_validate_and_cover_stages(capsys):
+    from distributed_sigmoid_loss_tpu.analysis.bench_schema import (
+        validate_record,
+    )
+    from distributed_sigmoid_loss_tpu.data.data_bench import run_data_bench
+
+    ns = argparse.Namespace(
+        batch=8, batches=2, model="tiny", data_shards="", data_workers=0,
+        image_hw="48x64", shards=2, pil_decode=False, no_read_ahead=False,
+        no_pipelined=False, no_zero_copy=False, seed=0,
+    )
+    records: list = []
+    assert run_data_bench(ns, collected=records) == 0
+    capsys.readouterr()  # the JSON lines themselves are not under test here
+    for r in records:
+        assert validate_record(r) == [], r
+    stages = {r["stage"] for r in records if r["metric"] == "data_bench_stage"}
+    assert stages == {
+        "shard_read", "decode", "tokenize", "augment", "h2d_commit",
+    }
+    (composed,) = [
+        r for r in records
+        if r["metric"] == "data_bench_pipeline_pairs_per_sec"
+    ]
+    assert composed["unit"] == "pairs/s"
+    assert composed["synthetic_ratio"] == pytest.approx(
+        composed["value"] / composed["synthetic_pairs_per_sec"], rel=0.01
+    )
+    assert 0.0 <= composed["input_wait_frac"] <= 1.0
+    assert composed["data_workers"] >= 1  # the RESOLVED value, not 0/auto
+    if composed["synthetic_ratio"] < 0.95:
+        # The acceptance contract's second arm: the record must attribute.
+        assert composed["bound_stage"] in stages
+        assert composed["worker_scaling"]
+    decode = next(r for r in records if r.get("stage") == "decode")
+    assert "1" in decode["worker_scaling"]
+
+
+def test_train_loop_logs_input_wait_frac(capsys, tmp_path):
+    """Acceptance: every train-loop metrics line carries input_wait_frac —
+    end to end through the CLI train path on a real shard stream."""
+    import json
+
+    from distributed_sigmoid_loss_tpu.cli import main
+
+    rng = np.random.default_rng(1)
+    write_tar_shard(
+        tmp_path / "train-000.tar",
+        [
+            (f"p{i}", rng.integers(0, 255, (20, 24, 3), dtype=np.uint8),
+             f"cap {i}")
+            for i in range(20)
+        ],
+        fmt="JPEG",
+        quality=90,
+    )
+    rc = main([
+        "train", "--tiny", "--steps", "2", "--batch", "16",
+        "--data-shards", str(tmp_path / "train-000.tar"),
+        "--data-workers", "2",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = [json.loads(ln) for ln in out.splitlines() if ln.startswith("{")]
+    metric_lines = [ln for ln in lines if "loss" in ln]
+    assert len(metric_lines) == 2
+    for ln in metric_lines:
+        assert 0.0 <= ln["input_wait_frac"] <= 1.0

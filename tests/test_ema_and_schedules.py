@@ -75,9 +75,14 @@ def test_unknown_schedule_raises():
         make_optimizer(cfg)
 
 
+@pytest.mark.slow
 def test_ema_in_train_state_end_to_end(tmp_path):
     """EMA wired through create_train_state/make_train_step: updated each step,
-    dtype-stable, checkpointable; missing ema with ema_decay raises clearly."""
+    dtype-stable, checkpointable; missing ema with ema_decay raises clearly.
+
+    slow: ~25 s on the tier-1 host (full train-state + checkpoint roundtrip);
+    the EMA math/warmup/jittability contracts stay standard above.
+    """
     from distributed_sigmoid_loss_tpu.data.synthetic import SyntheticImageText
     from distributed_sigmoid_loss_tpu.models import SigLIP
     from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
