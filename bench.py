@@ -1676,6 +1676,31 @@ def main():
         record["mfu"] = round(achieved_model_tflops / peak, 3)
         if hw_tflops is not None:
             record["hw_util"] = round(hw_tflops / peak, 3)
+    # graftscope static attribution (obs/attribution.py): per-kind collective
+    # wire bytes + the chip-free roofline mfu_est ride every headline record,
+    # so the number's attribution is pinned even when only the record (not a
+    # trace) survives. Trace-only (seconds next to the minutes of compile);
+    # never allowed to kill a measurement.
+    try:
+        from distributed_sigmoid_loss_tpu.obs.attribution import (
+            COLLECTIVE_KINDS,
+            jaxpr_costs,
+            roofline_estimate,
+        )
+
+        costs = jaxpr_costs(jax.make_jaxpr(step)(state, batch))
+        est = roofline_estimate(
+            costs["flops_est"], costs["comm_bytes_total"],
+            bytes_accessed=None, device_kind=device_kind,
+        )
+        record["mfu_est"] = est["mfu_est"]
+        record["roofline_bound"] = est["bound"]
+        record["comm_bytes_total"] = round(costs["comm_bytes_total"], 1)
+        for kind in COLLECTIVE_KINDS:
+            record[f"comm_bytes_{kind}"] = round(costs[f"comm_bytes_{kind}"], 1)
+    except Exception as e:
+        print(f"WARNING: static attribution failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
     _emit(record)
     return 0
 

@@ -69,6 +69,22 @@ BENCH_RECORD_FIELDS = frozenset(
         "stage", "data_workers", "native_decode", "worker_scaling",
         "synthetic_pairs_per_sec", "synthetic_ratio", "input_wait_frac",
         "pipelined", "read_ahead", "zero_copy", "bound_stage",
+        # graftscope static attribution (obs/attribution.py): the chip-free
+        # roofline estimate + per-kind collective wire bytes stamped on the
+        # train headline record (and every train metrics line)
+        "mfu_est", "roofline_bound", "comm_bytes_total",
+        "comm_bytes_all_gather", "comm_bytes_ppermute", "comm_bytes_psum",
+        "comm_bytes_psum_scatter", "comm_bytes_all_to_all",
+        # serve-bench record (cli.py cmd_serve_bench: invocation fields +
+        # the serve stats() snapshot spread in — the snapshot's own field
+        # set is declared in obs/metrics_schema.py SERVE_STATS_FIELDS and
+        # mirrored here so the one-JSON-line record validates end to end;
+        # stage_latency_ms carries the per-stage p50/p95/p99 percentiles)
+        "clients", "requests_sent", "batch_buckets", "max_wait_ms",
+        "sharded", "warmup_s", "uptime_s", "requests", "items", "qps",
+        "items_per_sec", "latency_ms", "batch_size_hist", "stage_latency_ms",
+        "rejected", "timeouts", "compile_count", "bucket_space", "index_size",
+        "cache",
     )
 )
 

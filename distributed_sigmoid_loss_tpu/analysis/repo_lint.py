@@ -19,6 +19,11 @@ review:
   module-level ``slow`` marker (protects the 870 s time-boxed tier-1 budget).
 - ``repo-bench-record``: every record-field string literal in bench.py must
   be registered in ``analysis/bench_schema.py`` (per-emit-path field drift).
+- ``repo-metrics-schema``: every train metrics-line / serve ``stats()`` /
+  health-event field literal in the emitting modules must be registered in
+  ``obs/metrics_schema.py`` — the same drift class as repo-bench-record, for
+  the OTHER two record streams (a metric added in one step builder but not
+  declared is invisible to every downstream parser until it breaks one).
 
 All checks take explicit source/path inputs so tests can falsify each rule on
 a known-bad fixture; the defaults audit the real repo.
@@ -39,8 +44,10 @@ __all__ = [
     "check_doc_staleness",
     "check_slow_markers",
     "check_bench_record_fields",
+    "check_metrics_schema",
     "MUTABLE_GLOBAL_ALLOWLIST",
     "SLOW_REQUIRED_TEST_MODULES",
+    "METRICS_SCHEMA_FILES",
 ]
 
 REPO_RULES = (
@@ -49,6 +56,7 @@ REPO_RULES = (
     "repo-doc-stale",
     "repo-slow-marker",
     "repo-bench-record",
+    "repo-metrics-schema",
 )
 
 _PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -573,6 +581,123 @@ def check_bench_record_fields(bench_source: str | None = None) -> list[Finding]:
     return findings
 
 
+_METRIC_DICT_NAMES = {"metrics", "line", "snap"}
+
+# The modules whose metric-field literals repo-metrics-schema audits, and the
+# registry (obs/metrics_schema.py) each validates against. Package-relative
+# paths; a module emitting a NEW record stream registers itself here.
+METRICS_SCHEMA_FILES = {
+    "train/train_step.py": "train",
+    "train/compressed_step.py": "train",
+    "cli.py": "train",
+    "serve/service.py": "serve",
+    "obs/health.py": "health",
+}
+
+
+def _metric_literals(tree: ast.Module) -> list[tuple[str, int]]:
+    """(field, lineno) for every metric-field string literal in a module:
+    dict literals bound to the conventional record names (``metrics`` /
+    ``line`` / ``snap``), subscript-assigns onto them, dict literals passed
+    to ``.log(step, {...})`` / ``.write({...})``, and the dict a function
+    named ``record`` returns (the HealthEvent convention). Dynamic keys
+    (f-strings like ``eval/{k}``) are invisible to AST and covered by the
+    registered prefixes at emit time instead."""
+    out: list[tuple[str, int]] = []
+
+    def take(d: ast.Dict, line: int) -> None:
+        for k in d.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out.append((k.value, line))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id in _METRIC_DICT_NAMES
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    take(node.value, node.lineno)
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in _METRIC_DICT_NAMES
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                ):
+                    out.append((t.slice.value, node.lineno))
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if (
+                node.func.attr == "log"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Dict)
+            ):
+                take(node.args[1], node.lineno)
+            elif (
+                node.func.attr == "write"
+                and node.args
+                and isinstance(node.args[0], ast.Dict)
+            ):
+                take(node.args[0], node.lineno)
+        elif isinstance(node, ast.FunctionDef) and node.name == "record":
+            for stmt in ast.walk(node):
+                if isinstance(stmt, ast.Return) and isinstance(
+                    stmt.value, ast.Dict
+                ):
+                    take(stmt.value, stmt.lineno)
+    return out
+
+
+def check_metrics_schema(sources=None, files=None) -> list[Finding]:
+    """repo-metrics-schema: metric-field literals in the emitting modules are
+    all registered in obs/metrics_schema.py (train lines / serve stats /
+    health events — the repo-bench-record discipline for the other two
+    record streams)."""
+    from distributed_sigmoid_loss_tpu.obs.metrics_schema import (
+        HEALTH_EVENT_FIELDS,
+        SERVE_STATS_FIELDS,
+        TRAIN_METRICS_FIELDS,
+        TRAIN_METRICS_PREFIXES,
+    )
+
+    schemas = {
+        "train": (TRAIN_METRICS_FIELDS, TRAIN_METRICS_PREFIXES),
+        "serve": (SERVE_STATS_FIELDS, ()),
+        "health": (HEALTH_EVENT_FIELDS, ()),
+    }
+    files = METRICS_SCHEMA_FILES if files is None else files
+    if sources is None:
+        sources = {}
+        for rel in files:
+            path = os.path.join(_PACKAGE_DIR, rel.replace("/", os.sep))
+            with open(path, encoding="utf-8") as f:
+                sources[rel] = f.read()
+    findings = []
+    for rel, kind in files.items():
+        src = sources.get(rel)
+        if src is None:
+            continue
+        fields, prefixes = schemas[kind]
+        for field_name, line in _metric_literals(ast.parse(src)):
+            if field_name in fields:
+                continue
+            if any(field_name.startswith(p) for p in prefixes):
+                continue
+            findings.append(Finding(
+                "repo-metrics-schema",
+                f"{rel}::{field_name}",
+                f"metric field {field_name!r} (line {line}) is not "
+                f"registered in obs/metrics_schema.py ({kind} schema) — "
+                "undeclared fields drift per emit path and are invisible "
+                "to downstream parsers; register it (and document it in "
+                "docs/OBSERVABILITY.md if it encodes a new signal)",
+            ))
+    return findings
+
+
 def run_repo_lint(disabled=()) -> list[Finding]:
     """Run every repo rule against the real tree."""
     checks = {
@@ -581,6 +706,7 @@ def run_repo_lint(disabled=()) -> list[Finding]:
         "repo-doc-stale": check_doc_staleness,
         "repo-slow-marker": check_slow_markers,
         "repo-bench-record": check_bench_record_fields,
+        "repo-metrics-schema": check_metrics_schema,
     }
     findings: list[Finding] = []
     for rule, fn in checks.items():

@@ -30,6 +30,11 @@ The subcommands tie the subsystems together:
   emulated CPU mesh (exit 1 on findings, ``--json``, per-rule ``--disable``).
   The same analyzers run in tier-1 (tests/test_analysis.py) and the dryrun —
   docs/ANALYSIS.md.
+- ``obs`` — graftscope offline reports: ``obs summarize DIR`` merges the
+  host spans a ``train --obs-dir`` run recorded with any device trace
+  capture under DIR into one where-the-time-goes report, optionally writing
+  a single merged Chrome-trace JSON (``--merged-out``) —
+  docs/OBSERVABILITY.md.
 
 ``train`` and ``eval`` accept ``--cpu-devices N`` to emulate an N-chip mesh on
 CPU — the TPU-native analogue of the reference's ``mp.spawn`` + Gloo localhost
@@ -475,6 +480,14 @@ def cmd_train(args) -> int:
               "family only (the softmax ring already streams its logsumexp)",
               file=sys.stderr)
         return 2
+    if args.watchdog == "skip" and not args.ckpt_dir:
+        # The jitted step DONATES its input state, so a poisoned update can
+        # only be undone by restoring a checkpoint — skip without --ckpt-dir
+        # would silently train on from the poisoned params.
+        print("--watchdog skip requires --ckpt-dir (skipping rolls back to "
+              "the last good checkpoint; without one there is nothing to "
+              "roll back to)", file=sys.stderr)
+        return 2
     if args.dcn_slices > 1 and not args.grad_compression:
         print("--dcn-slices without --grad-compression is a silent no-op: the "
               "regular step already spans slices when the dp axis is built "
@@ -790,7 +803,63 @@ def cmd_train(args) -> int:
             pp_microbatches=pp_micro,
         )
 
-    logger = MetricsLogger(every=args.log_every)
+    # graftscope wiring: schema-validated metrics lines, host spans (enabled
+    # only under --obs-dir — disabled spans are the allocation-free no-op),
+    # the health watchdog, and the always-on flight recorder.
+    from distributed_sigmoid_loss_tpu.obs import (
+        FlightRecorder,
+        HealthWatchdog,
+        SpanRecorder,
+    )
+    from distributed_sigmoid_loss_tpu.obs.metrics_schema import (
+        HEALTH_EVENT_FIELDS,
+        TRAIN_METRICS_FIELDS,
+        TRAIN_METRICS_PREFIXES,
+    )
+
+    logger = MetricsLogger(
+        every=args.log_every,
+        schema=TRAIN_METRICS_FIELDS,
+        schema_prefixes=TRAIN_METRICS_PREFIXES,
+    )
+    if args.obs_dir:
+        os.makedirs(args.obs_dir, exist_ok=True)
+    spans = SpanRecorder(enabled=bool(args.obs_dir))
+    flight = FlightRecorder(
+        path=os.path.join(args.obs_dir, "flight.json") if args.obs_dir
+        else None
+    )
+    watchdog = (
+        None if args.watchdog == "off"
+        else HealthWatchdog(policy="warn" if args.watchdog == "warn" else "skip")
+    )
+
+    # Static attribution of THE step that will run (obs/attribution.py):
+    # trace-only — seconds, no compile, chip-free — so every metrics line
+    # carries mfu_est + comm_bytes_total even when no chip ever materializes.
+    att_fields = {}
+    try:
+        from distributed_sigmoid_loss_tpu.obs.attribution import (
+            metrics_line_fields,
+            static_attribution,
+        )
+
+        abstract_batch = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), first
+        )
+        att_fields = metrics_line_fields(
+            static_attribution(step_fn, state, abstract_batch),
+            device_kind=jax.devices()[0].device_kind,
+        )
+        print(
+            "obs attribution: "
+            + " ".join(f"{k}={v}" for k, v in sorted(att_fields.items())),
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001 — attribution must never kill a run
+        print(f"WARNING: static attribution failed ({type(e).__name__}: {e}); "
+              "metrics lines will not carry mfu_est/comm_bytes_total",
+              file=sys.stderr)
 
     # Striped-shard sources already yield this host's LOCAL rows (batch/pcnt
     # each); synthetic sources yield the same deterministic GLOBAL batch on
@@ -844,17 +913,30 @@ def cmd_train(args) -> int:
 
     input_stats = PrefetchStats()
 
+    def place_spanned(b):
+        # h2d-commit runs on the prefetch worker thread; the span lands on
+        # its own track of the host timeline (SpanRecorder is thread-safe).
+        with spans.span("h2d_commit"):
+            return place(b)
+
     def device_batches(skip: int = 0):
         return _prefetch(
             host_batches(skip), mesh, size=2,
-            put=lambda b, m, a: place(b), stats=input_stats,
+            put=lambda b, m, a: place_spanned(b), stats=input_stats,
         )
 
     def log_metrics(step_i, m):
-        logger.log(step_i, {
+        line = {
             **{k: float(v) for k, v in m.items()},
             "input_wait_frac": input_stats.input_wait_frac(),
-        })
+            **att_fields,
+        }
+        if watchdog is not None:
+            for ev in watchdog.observe(step_i, line):
+                flight.note_event(ev)
+                logger.write(ev.record(), schema=HEALTH_EVENT_FIELDS)
+        flight.note_metrics(step_i, line)
+        logger.log(step_i, line)
 
     eval_hook = None
     if args.eval_every:
@@ -961,6 +1043,12 @@ def cmd_train(args) -> int:
                     on_metrics=log_metrics,
                     eval_every=args.eval_every,
                     on_eval=eval_hook,
+                    # --watchdog skip routes a non-finite loss into the
+                    # rollback-and-skip path instead of the halting raise;
+                    # either way the flight recorder dumps the trajectory.
+                    on_divergence="skip" if args.watchdog == "skip" else "halt",
+                    spans=spans,
+                    flight=flight,
                 )
             except RestoreRequiredError as e:
                 print(f"--ckpt-dir {args.ckpt_dir}: {e}", file=sys.stderr)
@@ -978,14 +1066,30 @@ def cmd_train(args) -> int:
     else:
         # 1-based step numbers, matching train_resilient's on_metrics contract.
         stream = device_batches()
+        i = 0  # the crash dump below must name a step even if fetch 1 dies
         try:
             for i, batch in zip(range(1, args.steps + 1), stream):
-                state, metrics = step_fn(state, batch)
+                with spans.span("step"):
+                    state, metrics = step_fn(state, batch)
                 log_metrics(i, metrics)
                 if eval_hook is not None and i % args.eval_every == 0:
-                    eval_hook(i, state)
+                    with spans.span("eval"):
+                        eval_hook(i, state)
+        except BaseException as e:
+            # Same black-box contract as the resilient loop: a crash leaves
+            # the last-N trajectory behind, not just a traceback.
+            flight.dump(f"crash at step {i}: {type(e).__name__}: {e}")
+            raise
         finally:
             stream.close()  # joins the worker; `data` is single-reader again
+
+    if args.obs_dir:
+        spans_path = os.path.join(args.obs_dir, "host_spans.trace.json")
+        spans.export(spans_path)
+        print(f"obs: host spans -> {spans_path} "
+              f"({len(spans.spans())} spans retained; summarize with "
+              f"`python -m distributed_sigmoid_loss_tpu obs summarize "
+              f"{args.obs_dir}`)", file=sys.stderr)
 
     # Zero-shot retrieval on a held-out synthetic batch (the model normalizes
     # its embeddings already).
@@ -1463,6 +1567,16 @@ def cmd_serve_bench(args) -> int:
         "warmup_s": round(warmup_s, 2),
         **snap,
     }
+    # Same emit contract as bench.py's _emit: validate against the declared
+    # record schema, warn on stderr, never lose the measurement.
+    from distributed_sigmoid_loss_tpu.analysis.bench_schema import (
+        validate_record,
+    )
+
+    problems = validate_record(record)
+    if problems:
+        print("WARNING: serve-bench record schema violation: "
+              + "; ".join(problems), file=sys.stderr)
     print(json.dumps(record))
     # Steady-state contract: every compile happened at warmup — one per shape
     # bucket. A violation means a request escaped the bucket grid.
@@ -1484,6 +1598,104 @@ def cmd_data_bench(args) -> int:
     from distributed_sigmoid_loss_tpu.data.data_bench import run_data_bench
 
     return run_data_bench(args)
+
+
+def cmd_obs(args) -> int:
+    """``obs summarize DIR``: one merged offline report of a run's host spans
+    (``host_spans.trace.json`` written by ``train --obs-dir``) and any device
+    trace capture (``*.trace.json.gz`` from ``utils.profiling.trace`` /
+    ``bench --profile``) found under DIR — the unified graftscope timeline,
+    no TensorBoard needed. ``--merged-out`` additionally writes one combined
+    Chrome-trace JSON that opens in ui.perfetto.dev with host and device
+    tracks side by side.
+    """
+    import glob as globmod
+    import json as jsonmod
+
+    if args.action != "summarize":
+        print(f"unknown obs action {args.action!r}", file=sys.stderr)
+        return 2
+    from distributed_sigmoid_loss_tpu.obs.spans import (
+        Span,
+        merge_chrome_traces,
+        summarize_spans,
+    )
+
+    host_trace = None
+    host_paths = sorted(
+        globmod.glob(os.path.join(args.dir, "**", "host_spans.trace.json"),
+                     recursive=True)
+    )
+    spans: list[Span] = []
+    if host_paths:
+        host_trace = {"traceEvents": []}
+        for path in host_paths:
+            with open(path, encoding="utf-8") as f:
+                trace = jsonmod.load(f)
+            host_trace["traceEvents"].extend(trace.get("traceEvents", []))
+        for ev in host_trace["traceEvents"]:
+            if ev.get("ph") == "X" and "dur" in ev:
+                t0 = ev["ts"] / 1e6
+                spans.append(Span(ev["name"], t0, t0 + ev["dur"] / 1e6,
+                                  ev.get("tid", 0)))
+
+    device_files = globmod.glob(
+        os.path.join(args.dir, "**", "*.trace.json.gz"), recursive=True
+    )
+
+    if not spans and not device_files:
+        print(f"no host_spans.trace.json or *.trace.json.gz under "
+              f"{args.dir!r} (train with --obs-dir and/or capture a device "
+              "trace with utils.profiling.trace / bench --profile)",
+              file=sys.stderr)
+        return 2
+
+    if spans:
+        print(f"== host spans ({len(spans)} retained, "
+              f"{len(host_paths)} file(s))")
+        print(f"  {'span':<28}{'count':>7}{'total ms':>11}{'mean ms':>9}"
+              f"{'p50':>8}{'p95':>8}{'max':>9}")
+        for name, row in summarize_spans(spans).items():
+            print(f"  {name:<28}{row['count']:>7}{row['total_ms']:>11.1f}"
+                  f"{row['mean_ms']:>9.2f}{row['p50_ms']:>8.2f}"
+                  f"{row['p95_ms']:>8.2f}{row['max_ms']:>9.2f}")
+
+    if device_files:
+        from distributed_sigmoid_loss_tpu.utils.profiling import (
+            summarize_device_ops,
+        )
+
+        dev = summarize_device_ops(args.dir, top=args.top)
+        if dev["categories"]:
+            print("\n== device ops by hlo_category "
+                  "(achieved rates over span time)")
+            print(f"  {'category':<28}{'ms':>10}{'share':>8}{'TFLOP/s':>9}"
+                  f"{'GB/s':>8}")
+            for name, ms, share, tf, gb in dev["categories"]:
+                print(f"  {name:<28}{ms:>10.1f}{share:>8.1%}{tf:>9.1f}"
+                      f"{gb:>8.0f}")
+            print("\n== top device ops")
+            for name, ms, n, tf, gb in dev["top_ops"]:
+                print(f"  {name:<42}{ms:>9.1f} ms  n={n:<5}"
+                      f"{tf:>7.1f} TF/s{gb:>7.0f} GB/s")
+        else:
+            print("\n(device trace files found but no 'XLA Ops' track — "
+                  "host-only capture?)")
+
+    if args.merged_out:
+        from distributed_sigmoid_loss_tpu.utils.profiling import (
+            _read_trace_files,
+        )
+
+        device_events = _read_trace_files(args.dir) if device_files else ()
+        merged = merge_chrome_traces(host_trace or {"traceEvents": []},
+                                     device_events)
+        with open(args.merged_out, "w", encoding="utf-8") as f:
+            jsonmod.dump(merged, f)
+        print(f"\nmerged chrome trace -> {args.merged_out} "
+              f"({len(merged['traceEvents'])} events; open in "
+              "ui.perfetto.dev)")
+    return 0
 
 
 def cmd_lint(args) -> int:
@@ -1737,6 +1949,24 @@ def main(argv=None) -> int:
                          "OUT of --data-dir/--data-shards — makes the "
                          "in-training curve a true validation curve")
     tr.add_argument("--log-every", type=int, default=1)
+    tr.add_argument("--obs-dir", default="", metavar="DIR",
+                    help="enable graftscope host-span recording: the train "
+                         "loop's fetch/h2d-commit/step/eval/checkpoint spans "
+                         "are written to DIR/host_spans.trace.json "
+                         "(Chrome-trace JSON — overlays a device capture in "
+                         "ui.perfetto.dev; merge offline with `obs summarize "
+                         "DIR`), and the flight recorder dumps to "
+                         "DIR/flight.json on crash/SIGTERM instead of stderr")
+    tr.add_argument("--watchdog", choices=["off", "warn", "skip"],
+                    default="warn",
+                    help="training health watchdog (obs/health.py): 'warn' "
+                         "(default) emits structured health_event records on "
+                         "NaN/Inf metrics and loss spikes vs the rolling "
+                         "median; 'skip' additionally routes a non-finite "
+                         "loss into the resilient loop's rollback-and-skip "
+                         "path (requires --ckpt-dir); 'off' disables "
+                         "detection (the grad_norm/param_norm/update_ratio "
+                         "scalars stay on every metrics line regardless)")
     tr.add_argument("--coordinator", default="",
                     help="multi-process rendezvous address host:port — every "
                          "process runs this same command with its own --process-id; "
@@ -1894,6 +2124,24 @@ def main(argv=None) -> int:
                     help="emulate N CPU devices (the h2d/composed stages "
                          "commit onto this mesh)")
 
+    ob = sub.add_parser(
+        "obs",
+        help="graftscope offline reports: `obs summarize DIR` merges the "
+             "host spans a --obs-dir run wrote with any device trace "
+             "capture under DIR into one where-the-time-goes report "
+             "(docs/OBSERVABILITY.md)",
+    )
+    ob.add_argument("action", choices=["summarize"],
+                    help="summarize: aggregate host spans + device op time "
+                         "found under DIR")
+    ob.add_argument("dir", help="directory holding host_spans.trace.json "
+                                "and/or *.trace.json.gz captures")
+    ob.add_argument("--top", type=int, default=12,
+                    help="rows per device-op table (obs summarize)")
+    ob.add_argument("--merged-out", default="", metavar="PATH",
+                    help="also write one merged Chrome-trace JSON (host + "
+                         "device events; open in ui.perfetto.dev)")
+
     ln = sub.add_parser(
         "lint",
         help="graftlint: repo-invariant linter + jaxpr collective/dtype "
@@ -1931,6 +2179,7 @@ def main(argv=None) -> int:
         "serve-bench": cmd_serve_bench,
         "data-bench": cmd_data_bench,
         "lint": cmd_lint,
+        "obs": cmd_obs,
     }
     return dispatch[args.cmd](args)
 

@@ -1,0 +1,59 @@
+"""graftscope: the unified observability layer — host tracing spans, static
+step attribution, a training health watchdog, and the metrics schema.
+
+Four parts, one goal — every perf or robustness claim arrives with its
+evidence attached, chip or no chip:
+
+- :mod:`.spans` — thread-safe ring-buffered host spans (train loop stages,
+  serve per-request stages) with Chrome-trace export that overlays the
+  device captures from ``utils.profiling.trace``; merged offline by the
+  ``obs summarize`` CLI subcommand.
+- :mod:`.attribution` — static per-step FLOPs, bytes, and per-kind
+  collective wire bytes from the traced jaxpr (no compile), plus compiled-
+  executable cost/memory readout, and the chip-free roofline ``mfu_est``
+  stamped on every train metrics line and bench record.
+- :mod:`.health` — host-side NaN/Inf + loss-spike watchdog emitting
+  structured events, and the flight recorder that dumps the last N metrics
+  lines on crash/SIGTERM through the resilience path.
+- :mod:`.metrics_schema` — the declared registry of every train-metrics and
+  serve-stats field, validated at emit by ``MetricsLogger`` and enforced
+  statically by graftlint's ``repo-metrics-schema`` rule.
+
+Import discipline: this package must stay importable without initializing
+jax (the linter and the CLI's argparse layer import the schema); anything
+jax-touching lives behind function-level imports in :mod:`.attribution`.
+"""
+
+from distributed_sigmoid_loss_tpu.obs.health import (  # noqa: F401
+    FlightRecorder,
+    HealthEvent,
+    HealthWatchdog,
+)
+from distributed_sigmoid_loss_tpu.obs.metrics_schema import (  # noqa: F401
+    HEALTH_EVENT_FIELDS,
+    SERVE_STATS_FIELDS,
+    TRAIN_METRICS_FIELDS,
+    TRAIN_METRICS_PREFIXES,
+    validate_metrics,
+)
+from distributed_sigmoid_loss_tpu.obs.spans import (  # noqa: F401
+    Span,
+    SpanRecorder,
+    merge_chrome_traces,
+    summarize_spans,
+)
+
+__all__ = [
+    "Span",
+    "SpanRecorder",
+    "summarize_spans",
+    "merge_chrome_traces",
+    "HealthWatchdog",
+    "HealthEvent",
+    "FlightRecorder",
+    "TRAIN_METRICS_FIELDS",
+    "TRAIN_METRICS_PREFIXES",
+    "SERVE_STATS_FIELDS",
+    "HEALTH_EVENT_FIELDS",
+    "validate_metrics",
+]

@@ -1,0 +1,412 @@
+"""Static step attribution: FLOPs, bytes and collective traffic from the
+PROGRAM, not the chip.
+
+The perf stream has been blind whenever the backend was (BENCH_r04/r05
+recorded 0.0): a number could only be attributed when a chip run succeeded.
+This module derives the attribution STATICALLY, two ways:
+
+- :func:`jaxpr_costs` / :func:`static_attribution` walk a traced jaxpr (the
+  same trace-only harness graftlint's auditor uses — seconds, no compile) and
+  count (a) matmul/conv FLOPs closed-form per ``dot_general`` /
+  ``conv_general_dilated`` (2·B·M·N·K, scan trip counts multiplied in), and
+  (b) per-device collective bytes BY KIND with the standard wire conventions
+  below. Bytes-moved, not FLOPs, is the lever for the memory-bound parts of
+  this workload ("Dissecting Embedding Bag Performance in DLRM Inference",
+  PAPERS.md) — so the comm traffic gets first-class, per-kind accounting.
+- :func:`attribution_of_compiled` reads an already-compiled executable:
+  XLA's own ``cost_analysis()`` (executed FLOPs / post-fusion bytes accessed)
+  plus ``utils.profiling.memory_stats_of_compiled`` (peak temp HBM).
+
+Per-device collective wire bytes, for a collective whose PER-SHARD operand is
+``s`` bytes over a mesh axis (or axes) of total size ``W``:
+
+==================  =======================  =================================
+primitive           bytes per device         rationale
+==================  =======================  =================================
+all_gather          ``(W-1)·s``              each device receives W-1 shards
+ppermute            ``s``                    one shard sent, one received
+psum                ``2·s·(W-1)/W``          ring all-reduce (reduce-scatter
+                                             + all-gather of 1/W chunks)
+psum_scatter        ``s·(W-1)/W``            ring reduce-scatter
+all_to_all          ``s·(W-1)/W``            every device keeps 1/W locally
+==================  =======================  =================================
+
+:func:`roofline_estimate` turns (flops, comm bytes, optionally bytes
+accessed) into a chip-free roofline: per-term times against a target chip's
+peak MXU rate / HBM bandwidth / ICI bandwidth, ``mfu_est`` = the MFU the
+config cannot exceed on that chip, and ``bound`` naming the limiting
+resource. ``device_kind`` defaults to the repo's target chip (v5e) so the
+estimate exists on CPU-only hosts — that is the point: the next driver-
+verified number arrives with its attribution already pinned, and until it
+does, every train metrics line and bench record carries the estimate.
+
+``bytes_est`` (trace-only) sums operand+result bytes per equation with scan
+multipliers — a fusion-ignorant UPPER bound on HBM traffic, reported but
+deliberately NOT fed into ``mfu_est`` (post-fusion truth is 5-20× lower;
+use the compiled ``bytes_accessed`` when an executable is at hand).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable
+
+__all__ = [
+    "CHIP_SPECS",
+    "DEFAULT_CHIP",
+    "COLLECTIVE_KINDS",
+    "jaxpr_costs",
+    "static_attribution",
+    "attribution_of_compiled",
+    "roofline_estimate",
+    "step_config_attribution",
+    "metrics_line_fields",
+]
+
+# device_kind -> (peak dense bf16 TFLOP/s, HBM GB/s, aggregate ICI GB/s per
+# chip). Public spec-sheet figures; the TFLOP/s column matches bench.py's
+# PEAK_BF16_TFLOPS so MFU and mfu_est share one basis.
+CHIP_SPECS = {
+    "TPU v4": (275.0, 1228.0, 300.0),
+    "TPU v5 lite": (197.0, 819.0, 200.0),
+    "TPU v5e": (197.0, 819.0, 200.0),
+    "TPU v5": (459.0, 2765.0, 400.0),
+    "TPU v5p": (459.0, 2765.0, 400.0),
+    "TPU v6 lite": (918.0, 1640.0, 400.0),
+    "TPU v6e": (918.0, 1640.0, 400.0),
+}
+
+# The repo's roofline target (VERDICT r5 / docs/PERF.md argue against it):
+# estimates on chip-less hosts are computed for this part.
+DEFAULT_CHIP = "TPU v5 lite"
+
+COLLECTIVE_KINDS = (
+    "all_gather", "ppermute", "psum", "psum_scatter", "all_to_all",
+)
+
+# Wire-bytes factor as a function of axis size W, per primitive family.
+_WIRE_FACTORS = {
+    "all_gather": lambda w: w - 1,
+    "ppermute": lambda w: 1.0,
+    "psum": lambda w: 2.0 * (w - 1) / w,
+    "psum_scatter": lambda w: (w - 1) / w,
+    "reduce_scatter": lambda w: (w - 1) / w,
+    "all_to_all": lambda w: (w - 1) / w,
+    "pgather": lambda w: w - 1,
+    "pbroadcast": lambda w: (w - 1) / w,
+}
+
+# Primitive name -> the kind bucket it reports under.
+_KIND_OF = {
+    "all_gather": "all_gather",
+    "pgather": "all_gather",
+    "ppermute": "ppermute",
+    "psum": "psum",
+    "psum_scatter": "psum_scatter",
+    "reduce_scatter": "psum_scatter",
+    "all_to_all": "all_to_all",
+    "pbroadcast": "all_to_all",
+}
+
+
+def _aval_bytes(v) -> float:
+    aval = getattr(v, "aval", None)
+    if aval is None:
+        return 0.0
+    size = getattr(aval, "size", None)
+    dtype = getattr(aval, "dtype", None)
+    if size is None or dtype is None:
+        return 0.0
+    return float(size) * getattr(dtype, "itemsize", 4)
+
+
+def _collective_axes(eqn) -> tuple:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name"))
+    if axes is None:
+        return ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    flat = []
+    for a in axes:
+        if isinstance(a, (tuple, list)):
+            flat.extend(a)
+        else:
+            flat.append(a)
+    return tuple(a for a in flat if isinstance(a, str))
+
+
+def _dot_general_flops(eqn) -> float:
+    """2·B·M·N·K for one dot_general application."""
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs = getattr(eqn.invars[0], "aval", None)
+    rhs = getattr(eqn.invars[1], "aval", None)
+    if lhs is None or rhs is None:
+        return 0.0
+    ls, rs = lhs.shape, rhs.shape
+    batch = math.prod(ls[i] for i in lb) if lb else 1
+    k = math.prod(ls[i] for i in lc) if lc else 1
+    m = math.prod(
+        d for i, d in enumerate(ls) if i not in lc and i not in lb
+    )
+    n = math.prod(
+        d for i, d in enumerate(rs) if i not in rc and i not in rb
+    )
+    return 2.0 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    """2 · |out| · (MACs per output element) for conv_general_dilated."""
+    out = getattr(eqn.outvars[0], "aval", None)
+    rhs = getattr(eqn.invars[1], "aval", None)
+    if out is None or rhs is None:
+        return 0.0
+    dn = eqn.params.get("dimension_numbers")
+    try:
+        out_features = rhs.shape[dn.rhs_spec[0]]
+    except Exception:
+        out_features = rhs.shape[-1]
+    macs_per_out = math.prod(rhs.shape) / max(1, out_features)
+    return 2.0 * math.prod(out.shape) * macs_per_out
+
+
+def _jaxpr_of(obj):
+    if hasattr(obj, "eqns") and hasattr(obj, "invars"):
+        return obj
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    return None
+
+
+def _sub_jaxprs(params: dict):
+    out = []
+    for k, v in params.items():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for u in vals:
+            j = _jaxpr_of(u)
+            if j is not None:
+                out.append(j)
+    return out
+
+
+class _Costs:
+    __slots__ = ("flops", "bytes_est", "comm")
+
+    def __init__(self):
+        self.flops = 0.0
+        self.bytes_est = 0.0
+        self.comm = {k: 0.0 for k in COLLECTIVE_KINDS}
+
+
+def _walk(jaxpr, bound: dict, mult: float, acc: _Costs) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+
+        if name == "shard_map":
+            inner_bound = dict(bound)
+            mesh = eqn.params.get("mesh")
+            auto = eqn.params.get("auto") or frozenset()
+            try:
+                inner_bound.update({
+                    ax: sz for ax, sz in dict(mesh.shape).items()
+                    if ax not in auto
+                })
+            except Exception:
+                pass
+            inner = _jaxpr_of(eqn.params.get("jaxpr"))
+            if inner is not None:
+                _walk(inner, inner_bound, mult, acc)
+            continue
+
+        if name == "scan":
+            body = _jaxpr_of(eqn.params.get("jaxpr"))
+            length = float(eqn.params.get("length", 1) or 1)
+            if body is not None:
+                _walk(body, bound, mult * length, acc)
+            continue
+
+        if name == "cond":
+            # Branches are alternatives, not a sequence: charge the costliest
+            # one (the conservative upper bound for a static estimate).
+            best = None
+            for br in eqn.params.get("branches", ()):
+                inner = _jaxpr_of(br)
+                if inner is None:
+                    continue
+                sub = _Costs()
+                _walk(inner, bound, mult, sub)
+                score = sub.flops + sub.bytes_est + sum(sub.comm.values())
+                if best is None or score > (
+                    best.flops + best.bytes_est + sum(best.comm.values())
+                ):
+                    best = sub
+            if best is not None:
+                acc.flops += best.flops
+                acc.bytes_est += best.bytes_est
+                for k, v in best.comm.items():
+                    acc.comm[k] += v
+            continue
+
+        if name in _KIND_OF:
+            axes = _collective_axes(eqn)
+            w = 1
+            for ax in axes:
+                w *= int(bound.get(ax, 1))
+            if w > 1:
+                factor = _WIRE_FACTORS[name](w)
+                s = sum(_aval_bytes(v) for v in eqn.invars)
+                acc.comm[_KIND_OF[name]] += factor * s * mult
+            continue
+
+        subs = _sub_jaxprs(eqn.params)
+        if subs:
+            # Call-like eqns (pjit / remat2 / custom_vjp / while bodies):
+            # recurse only — counting the call's own operand bytes would
+            # double what the body already counts. while trip counts are
+            # unknowable statically; its body is charged once (documented).
+            for inner in subs:
+                _walk(inner, bound, mult, acc)
+            continue
+
+        if name == "dot_general":
+            acc.flops += _dot_general_flops(eqn) * mult
+        elif name == "conv_general_dilated":
+            acc.flops += _conv_flops(eqn) * mult
+        acc.bytes_est += (
+            sum(_aval_bytes(v) for v in eqn.invars)
+            + sum(_aval_bytes(v) for v in eqn.outvars)
+        ) * mult
+
+
+def jaxpr_costs(jaxpr_or_closed, bound_axes: dict | None = None) -> dict:
+    """Walk one (closed) jaxpr into the static cost dict.
+
+    Returns ``{"flops_est", "bytes_est", "comm_bytes_total",
+    "comm_bytes_all_gather", "comm_bytes_ppermute", "comm_bytes_psum",
+    "comm_bytes_psum_scatter", "comm_bytes_all_to_all"}`` — flops/bytes are
+    PER DEVICE (shard_map bodies trace per-shard shapes; the GSPMD outer
+    program is counted at its global shapes, which for the dp-replicated
+    towers of this repo is the per-device program too).
+    """
+    j = _jaxpr_of(jaxpr_or_closed)
+    if j is None:
+        raise TypeError(f"not a jaxpr: {jaxpr_or_closed!r}")
+    acc = _Costs()
+    _walk(j, dict(bound_axes or {}), 1.0, acc)
+    out = {
+        "flops_est": acc.flops,
+        "bytes_est": acc.bytes_est,
+        "comm_bytes_total": sum(acc.comm.values()),
+    }
+    for kind in COLLECTIVE_KINDS:
+        out[f"comm_bytes_{kind}"] = acc.comm[kind]
+    return out
+
+
+def static_attribution(fn, *args, bound_axes: dict | None = None) -> dict:
+    """Trace ``fn(*args)`` (abstract — ShapeDtypeStructs work) and return its
+    :func:`jaxpr_costs`. The trace-only path: seconds, no compile, CPU-safe —
+    what cmd_train stamps onto every metrics line."""
+    import jax
+
+    return jaxpr_costs(jax.make_jaxpr(fn)(*args), bound_axes=bound_axes)
+
+
+def attribution_of_compiled(compiled) -> dict:
+    """What XLA says about an already-compiled executable: executed FLOPs and
+    post-fusion bytes accessed (``cost_analysis``), plus the static memory
+    accounting (``memory_stats_of_compiled`` — ``temp_size_in_bytes`` is the
+    peak-temp figure memory optimizations are judged by). Fields are None
+    when the backend withholds the analysis."""
+    from distributed_sigmoid_loss_tpu.utils.profiling import (
+        memory_stats_of_compiled,
+    )
+
+    out = {"flops_exec": None, "bytes_accessed": None}
+    try:
+        cost = compiled.cost_analysis()
+        if cost:
+            if cost.get("flops", 0) > 0:
+                out["flops_exec"] = float(cost["flops"])
+            ba = cost.get("bytes accessed", 0)
+            if ba > 0:
+                out["bytes_accessed"] = float(ba)
+    except Exception:
+        pass
+    mem = memory_stats_of_compiled(compiled)
+    out["peak_temp_bytes"] = mem["temp_size_in_bytes"] if mem else None
+    out["peak_bytes"] = mem["peak_bytes"] if mem else None
+    return out
+
+
+def roofline_estimate(
+    flops: float,
+    comm_bytes_total: float,
+    bytes_accessed: float | None = None,
+    device_kind: str | None = None,
+) -> dict:
+    """Chip-free roofline: per-resource step-time lower bounds against the
+    target chip, the limiting resource, and ``mfu_est`` — the MFU ceiling the
+    program's arithmetic/traffic ratio permits there. ``mfu_est`` is an
+    upper bound on achievable MFU, not a prediction of the measured one
+    (overlap, dispatch and kernel overheads only lower it further)."""
+    kind = device_kind if device_kind in CHIP_SPECS else DEFAULT_CHIP
+    tflops, hbm_gbps, ici_gbps = CHIP_SPECS[kind]
+    compute_s = flops / (tflops * 1e12)
+    comm_s = comm_bytes_total / (ici_gbps * 1e9)
+    mem_s = (bytes_accessed or 0.0) / (hbm_gbps * 1e9)
+    terms = {"compute": compute_s, "comm": comm_s, "memory": mem_s}
+    t_bound = max(terms.values())
+    bound = max(terms, key=terms.get) if t_bound > 0 else "compute"
+    mfu_est = (compute_s / t_bound) if t_bound > 0 else 0.0
+    return {
+        "mfu_est": round(mfu_est, 3),
+        "bound": bound,
+        "est_step_ms_lower_bound": round(t_bound * 1e3, 3),
+        "roofline_chip": kind,
+    }
+
+
+def step_config_attribution(
+    n_devices: int | None = None,
+    labels: Iterable[str] | None = None,
+    device_kind: str | None = None,
+) -> dict:
+    """Static attribution for the step configs graftlint already enumerates.
+
+    Reuses ``analysis/jaxpr_audit.step_config_jaxprs`` (the REAL step
+    builders traced abstractly on the virtual CPU mesh) — label ->
+    ``jaxpr_costs`` + ``roofline_estimate``. Trace-only; the compiled-side
+    fields (peak temp) come from :func:`attribution_of_compiled` on whatever
+    executable the caller actually compiles.
+    """
+    from distributed_sigmoid_loss_tpu.analysis.jaxpr_audit import (
+        step_config_jaxprs,
+    )
+
+    jaxprs = step_config_jaxprs(n_devices)
+    want = set(labels) if labels is not None else set(jaxprs)
+    out = {}
+    for label, (closed, _kwargs) in jaxprs.items():
+        if label not in want:
+            continue
+        costs = jaxpr_costs(closed)
+        costs.update(roofline_estimate(
+            costs["flops_est"], costs["comm_bytes_total"],
+            device_kind=device_kind,
+        ))
+        out[label] = costs
+    return out
+
+
+def metrics_line_fields(costs: dict, device_kind: str | None = None) -> dict:
+    """The two attribution scalars every train metrics line carries:
+    ``mfu_est`` (roofline ceiling on the target chip) and
+    ``comm_bytes_total`` (per-device wire bytes per step)."""
+    est = roofline_estimate(
+        costs["flops_est"], costs["comm_bytes_total"], device_kind=device_kind
+    )
+    return {
+        "mfu_est": est["mfu_est"],
+        "comm_bytes_total": float(costs["comm_bytes_total"]),
+    }

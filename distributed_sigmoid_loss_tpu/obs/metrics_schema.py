@@ -1,0 +1,93 @@
+"""THE declared schema for train metrics lines and serve ``stats()`` fields.
+
+``analysis/bench_schema.py`` fixed per-emit-path drift for bench.py's JSON
+records; this module is the same registry for the OTHER two record streams —
+the train loop's metrics lines (``MetricsLogger.log``) and the serving
+stack's ``stats()`` snapshots / health events (``MetricsLogger.write``).
+Before it, a metric field added in ``train_step.py`` but not
+``compressed_step.py`` (or vice versa — ``ef_norm`` already only exists on
+one path, correctly, but nothing DECLARED that) drifted silently, and
+downstream per-metric parsers learned field names from whatever happened to
+be emitted.
+
+One registry, three consumers:
+
+- ``utils.logging.MetricsLogger`` validates at emit time when constructed
+  with ``schema=...`` (stderr warning; the line still prints — a metric must
+  never be lost to its own validator, the bench ``_emit`` convention).
+- ``tests/test_obs.py`` asserts real emit paths validate.
+- ``analysis/repo_lint.py`` rule ``repo-metrics-schema`` statically
+  cross-checks every metric-field string literal in the emitting modules
+  against this registry, so an undeclared field fails tier-1 before it ever
+  reaches a log parser.
+
+Stdlib-only module (imported by the linter and bench paths that must not
+initialize jax).
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "TRAIN_METRICS_FIELDS",
+    "TRAIN_METRICS_PREFIXES",
+    "SERVE_STATS_FIELDS",
+    "HEALTH_EVENT_FIELDS",
+    "validate_metrics",
+]
+
+# Every field a train metrics line may carry, grouped by the layer that owns
+# it. Adding a field to a step's metrics dict (or cli.py's log_metrics merge)
+# without registering it here fails the repo-metrics-schema lint rule.
+TRAIN_METRICS_FIELDS = frozenset({
+    # MetricsLogger bookkeeping
+    "step", "steps_per_sec",
+    # train/train_step.py + train/compressed_step.py step metrics
+    "loss", "t", "bias", "grad_norm", "param_norm", "update_ratio",
+    "moe_aux", "ef_norm",
+    # data/loader.py prefetch starvation (cli.py log_metrics)
+    "input_wait_frac",
+    # obs/attribution.py static attribution (cli.py log_metrics)
+    "mfu_est", "comm_bytes_total",
+})
+
+# Prefix-namespaced families (dynamic keys): the in-training eval hook logs
+# eval/i2t_recall@K etc. — any key under a registered prefix validates.
+TRAIN_METRICS_PREFIXES = ("eval/",)
+
+# serve/service.py stats() snapshot + the serve_stats/serve-bench records
+# built from it (cli.py cmd_serve_bench spreads the snapshot into its
+# record, so these are also registered in analysis/bench_schema.py).
+SERVE_STATS_FIELDS = frozenset({
+    "metric", "uptime_s", "requests", "items", "qps", "items_per_sec",
+    "latency_ms", "batch_size_hist", "stage_latency_ms", "rejected",
+    "timeouts", "compile_count", "bucket_space", "index_size", "cache",
+})
+
+# obs/health.py HealthEvent.record() — the structured watchdog events the
+# train loop writes through the same logger.
+HEALTH_EVENT_FIELDS = frozenset({"metric", "step", "event", "detail"})
+
+
+def validate_metrics(
+    record,
+    fields=TRAIN_METRICS_FIELDS,
+    prefixes: tuple = TRAIN_METRICS_PREFIXES,
+) -> list[str]:
+    """Validate one record's field NAMESPACE against a declared field set.
+
+    Returns problem strings (empty = valid). Values are not typed here —
+    the namespace is what drifts (the bench_schema convention).
+    """
+    if not isinstance(record, dict):
+        return [f"record must be a dict, got {type(record).__name__}"]
+    problems = []
+    for key in record:
+        if key in fields:
+            continue
+        if any(key.startswith(p) for p in prefixes):
+            continue
+        problems.append(
+            f"unregistered metric field {key!r} — register it in "
+            "obs/metrics_schema.py"
+        )
+    return problems

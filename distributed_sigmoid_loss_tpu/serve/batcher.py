@@ -16,6 +16,15 @@ queued work stays bounded by ``max_queue / throughput``.
 
 The batch function runs on the worker thread only, one call at a time, so a
 non-thread-safe engine path is safe behind a batcher.
+
+Per-stage observability (graftscope): every request's life splits into
+queue-wait (enqueue → its batch starts assembling... strictly: → assembly
+done), batch-assembly (deadline coalescing after the first item), device
+(the ``run_batch`` engine call) and reply (future fan-out). Each stage feeds
+a bounded :class:`~distributed_sigmoid_loss_tpu.utils.logging.LatencyWindow`
+(surfaced as ``stage_latency_ms`` in ``EmbeddingService.stats()``) and,
+when a ``SpanRecorder`` is attached, a host span on the worker's timeline —
+so a p99 regression names its stage instead of an opaque end-to-end number.
 """
 
 from __future__ import annotations
@@ -28,7 +37,11 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
-__all__ = ["MicroBatcher", "QueueFullError", "BatcherClosedError"]
+from distributed_sigmoid_loss_tpu.utils.logging import LatencyWindow
+
+BATCH_STAGES = ("queue_wait", "assembly", "device", "reply")
+
+__all__ = ["MicroBatcher", "QueueFullError", "BatcherClosedError", "BATCH_STAGES"]
 
 
 class QueueFullError(RuntimeError):
@@ -66,6 +79,7 @@ class MicroBatcher:
         max_wait_ms: float = 5.0,
         max_queue: int = 1024,
         name: str = "batcher",
+        spans=None,
     ):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
@@ -74,10 +88,15 @@ class MicroBatcher:
         self._run_batch = run_batch
         self.max_batch_size = max_batch_size
         self.max_wait = max_wait_ms / 1000.0
+        self.name = name
+        self._spans = spans  # SpanRecorder or None (obs/spans.py)
         self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
         self._closed = False
         self._hist_lock = threading.Lock()
         self._batch_sizes: Counter[int] = Counter()
+        # Small windows: a batcher's stage stats cover recent traffic, and
+        # four windows per batcher must stay cheap.
+        self._stage_windows = {s: LatencyWindow(2048) for s in BATCH_STAGES}
         self._worker = threading.Thread(
             target=self._loop, name=f"{name}-worker", daemon=True
         )
@@ -125,14 +144,31 @@ class MicroBatcher:
         with self._hist_lock:
             return dict(sorted(self._batch_sizes.items()))
 
+    def stage_latency_ms(self) -> dict[str, dict[str, float]]:
+        """{stage: {p50_ms, p95_ms, p99_ms}} per batching stage — queue_wait
+        and reply are per REQUEST, assembly and device per engine CALL."""
+        return {
+            stage: w.percentiles_ms((50, 95, 99))
+            for stage, w in self._stage_windows.items()
+        }
+
+    def _stage(self, stage: str, t0: float, t1: float) -> None:
+        self._stage_windows[stage].record(t1 - t0)
+        if self._spans is not None:
+            self._spans.record(f"serve/{self.name}/{stage}", t0, t1)
+
     # -- worker side ---------------------------------------------------------
 
-    def _collect(self) -> list[_Request] | None:
+    def _collect(self) -> tuple[list[_Request], float] | None:
         """Block for the first request, then fill the batch until size or the
-        first request's deadline. None = sentinel seen with nothing pending."""
+        first request's deadline. None = sentinel seen with nothing pending.
+        Returns ``(batch, t_assembly_start)`` — assembly starts when the
+        worker picks the first item up (queue wait before that belongs to the
+        queue_wait stage, not assembly)."""
         first = self._queue.get()
         if first is _SENTINEL:
             return None
+        t_assembly = time.monotonic()
         batch = [first]
         deadline = first.enqueued_at + self.max_wait
         while len(batch) < self.max_batch_size:
@@ -148,22 +184,32 @@ class MicroBatcher:
                 self._queue.put(_SENTINEL)
                 break
             batch.append(nxt)
-        return batch
+        return batch, t_assembly
 
     def _loop(self) -> None:
         while True:
-            batch = self._collect()
-            if batch is None:
+            collected = self._collect()
+            if collected is None:
                 return
+            batch, t_assembly = collected
+            t_run = time.monotonic()
+            # Per-request queue wait: enqueue → assembly done (the moment its
+            # engine call starts); per-call assembly: the coalescing window.
+            for r in batch:
+                self._stage("queue_wait", r.enqueued_at, t_run)
+            self._stage("assembly", t_assembly, t_run)
             with self._hist_lock:
                 self._batch_sizes[len(batch)] += 1
             try:
                 results = self._run_batch([r.item for r in batch])
             except Exception as e:  # noqa: BLE001 — fan the failure out
+                self._stage("device", t_run, time.monotonic())
                 for r in batch:
                     if not r.future.cancelled():
                         r.future.set_exception(e)
                 continue
+            t_reply = time.monotonic()
+            self._stage("device", t_run, t_reply)
             if len(results) != len(batch):
                 err = RuntimeError(
                     f"run_batch returned {len(results)} results for "
@@ -176,3 +222,4 @@ class MicroBatcher:
             for r, res in zip(batch, results):
                 if not r.future.cancelled():
                     r.future.set_result(res)
+            self._stage("reply", t_reply, time.monotonic())

@@ -59,6 +59,7 @@ class EmbeddingService:
         max_queue: int = 1024,
         default_timeout: float | None = 10.0,
         logger: MetricsLogger | None = None,
+        spans=None,
     ):
         self.engine = engine
         self.tokenize = tokenize
@@ -66,16 +67,22 @@ class EmbeddingService:
         self.index = index if index is not None else RetrievalIndex()
         self.default_timeout = default_timeout
         self.logger = logger
+        # Optional obs/spans.py SpanRecorder: per-request spans on the caller
+        # threads plus per-stage (queue-wait / assembly / device / reply)
+        # spans on the batcher workers — one overlayable host timeline.
+        self.spans = spans
         if max_batch_size is None:
             max_batch_size = engine.batch_buckets[-1]
         self._batchers = {
             "text": MicroBatcher(
                 self._encode_rows_text, max_batch_size=max_batch_size,
                 max_wait_ms=max_wait_ms, max_queue=max_queue, name="text",
+                spans=spans,
             ),
             "image": MicroBatcher(
                 self._encode_rows_image, max_batch_size=max_batch_size,
                 max_wait_ms=max_wait_ms, max_queue=max_queue, name="image",
+                spans=spans,
             ),
         }
         self._latency = LatencyWindow()
@@ -173,7 +180,10 @@ class EmbeddingService:
             with self._lock:
                 self._requests += 1
                 self._items += len(rows)
-            self._latency.record(time.monotonic() - t0)
+            t1 = time.monotonic()
+            self._latency.record(t1 - t0)
+            if self.spans is not None:
+                self.spans.record(f"serve/request/{kind}", t0, t1)
         return np.stack(results)
 
     def encode_text(self, texts, *, timeout: float | None = None) -> np.ndarray:
@@ -215,9 +225,16 @@ class EmbeddingService:
             "items": items,
             "qps": round(requests / elapsed, 2),
             "items_per_sec": round(items / elapsed, 2),
-            "latency_ms": self._latency.percentiles_ms((50, 95)),
+            "latency_ms": self._latency.percentiles_ms((50, 95, 99)),
             "batch_size_hist": {
                 kind: b.batch_size_histogram()
+                for kind, b in self._batchers.items()
+            },
+            # Per-stage tails (queue_wait / assembly / device / reply per
+            # modality): the stage a p99 regression lives in, not just that
+            # one exists.
+            "stage_latency_ms": {
+                kind: b.stage_latency_ms()
                 for kind, b in self._batchers.items()
             },
             "rejected": rejected,
@@ -231,10 +248,17 @@ class EmbeddingService:
         return snap
 
     def log_stats(self) -> dict:
-        """Emit :meth:`stats` through the wired MetricsLogger; returns it."""
+        """Emit :meth:`stats` through the wired MetricsLogger (validated
+        against the declared serve-stats schema); returns it."""
         snap = self.stats()
         if self.logger is not None:
-            self.logger.write({"metric": "serve_stats", **snap})
+            from distributed_sigmoid_loss_tpu.obs.metrics_schema import (
+                SERVE_STATS_FIELDS,
+            )
+
+            self.logger.write(
+                {"metric": "serve_stats", **snap}, schema=SERVE_STATS_FIELDS
+            )
         return snap
 
     def close(self) -> None:

@@ -478,16 +478,25 @@ def make_compressed_train_step(
             loss, lp, aux, grads = sharded_grads(
                 state.params, batch["images"], batch["tokens"]
             )
+        prev_params = state.params  # update_ratio needs the pre-update tree
         state = state.apply_gradients(grads=grads)
         if zero1:
             state = state.replace(
                 opt_state=zero1_constrain(state.opt_state, mesh, axis)
             )
+        # Same health scalars as make_train_step (obs/health.py watchdog
+        # inputs) — the metrics-line contract must not differ per step mode.
+        param_norm = optax.global_norm(state.params)
+        update_norm = optax.global_norm(
+            jax.tree.map(lambda n, o: n - o, state.params, prev_params)
+        )
         metrics = {
             "loss": loss,
             "t": jnp.exp(lp["t_prime"]),
             "bias": lp["bias"],
             "grad_norm": optax.global_norm(grads),
+            "param_norm": param_norm,
+            "update_ratio": update_norm / (param_norm + 1e-12),
         }
         if moe_aux_weight is not None:
             metrics["moe_aux"] = aux

@@ -223,6 +223,8 @@ def train_resilient(
     saver=None,
     eval_every: int = 0,
     on_eval: Callable[[int, Any], None] | None = None,
+    spans=None,
+    flight=None,
 ) -> tuple[Any, ResilienceReport]:
     """Run ``step_fn`` to ``total_steps`` with checkpoint/resume, preemption
     checkpointing, and divergence detection.
@@ -262,7 +264,24 @@ def train_resilient(
     state)`` runs between the update and the checkpoint decision — the
     in-training validation hook (it may sync the device; that is the caller's
     choice to make, same contract as ``on_metrics``).
+
+    ``spans`` (an ``obs.SpanRecorder``): the loop's stages — ``fetch`` (next
+    batch off the iterator; with the prefetch pipeline upstream this is
+    consumer wait, the host-side twin of ``input_wait_frac``), ``step``
+    (dispatch + any sync the step's own returns force), ``eval`` and
+    ``checkpoint`` — land on the host timeline. None (default) costs one
+    attribute check per stage.
+
+    ``flight`` (an ``obs.FlightRecorder``): dumped — last N metrics lines +
+    health events — whenever control leaves the loop abnormally: the
+    divergence raise, the SIGTERM preemption stop, or any crash that
+    propagates out of a step/data fetch. Feeding it lines is the caller's
+    ``on_metrics`` job (the loop only owns the dump points).
     """
+    from distributed_sigmoid_loss_tpu.obs.spans import SpanRecorder
+
+    if spans is None:
+        spans = SpanRecorder(enabled=False)
     report = ResilienceReport()
     resumed = restore_latest(ckpt_dir, state)
     if resumed is None and require_restore:
@@ -284,52 +303,73 @@ def train_resilient(
             # Orbax saves the (possibly multi-host, sharded) global arrays
             # directly — no device_get, which would fail on non-addressable
             # shards and waste a host copy on single-host.
-            save_step(ckpt_dir, s, st, saver=saver)
+            with spans.span("checkpoint"):
+                save_step(ckpt_dir, s, st, saver=saver)
             report.checkpoints.append(s)
             last_good = s
 
-    while step < total_steps:
-        try:
-            batch = next(it)
-        except StopIteration:
-            # Data exhausted early: the docstring's "saves when the loop ends"
-            # contract still holds, so a restart resumes from here.
-            save(step, state)
-            break
-        new_state, metrics = step_fn(state, batch)
+    try:
+        while step < total_steps:
+            try:
+                with spans.span("fetch"):
+                    batch = next(it)
+            except StopIteration:
+                # Data exhausted early: the docstring's "saves when the loop
+                # ends" contract still holds, so a restart resumes from here.
+                save(step, state)
+                break
+            with spans.span("step"):
+                new_state, metrics = step_fn(state, batch)
 
-        check_now = (step + 1) % max(1, check_finite_every) == 0
-        if check_now and not np.isfinite(loss := float(metrics["loss"])):
-            report.divergences += 1
-            if saver is not None:
-                # The newest (rollback target) checkpoint may still be writing.
-                saver.wait()
-            restored = restore_latest(ckpt_dir, state)
-            restored_state, restored_step = (None, None)
-            if restored is not None:
-                restored_state, restored_step = restored
-                state = restored_state
-            if on_divergence == "halt":
-                report.final_step = step
-                raise TrainingDiverged(step, loss, restored_step, restored_state)
-            # "skip": keep the restored (or current, if no checkpoint) params,
-            # drop the poisoned update, move on to the next batch.
+            check_now = (step + 1) % max(1, check_finite_every) == 0
+            if check_now and not np.isfinite(loss := float(metrics["loss"])):
+                report.divergences += 1
+                if saver is not None:
+                    # The newest (rollback target) checkpoint may still be
+                    # writing.
+                    saver.wait()
+                restored = restore_latest(ckpt_dir, state)
+                restored_state, restored_step = (None, None)
+                if restored is not None:
+                    restored_state, restored_step = restored
+                    state = restored_state
+                if on_divergence == "halt":
+                    report.final_step = step
+                    if flight is not None:
+                        flight.dump(f"divergence: non-finite loss at step {step}")
+                    raise TrainingDiverged(
+                        step, loss, restored_step, restored_state
+                    )
+                # "skip": keep the restored (or current, if no checkpoint)
+                # params, drop the poisoned update, move on to the next batch.
+                step += 1
+                continue
+
+            state = new_state
             step += 1
-            continue
+            if on_metrics is not None:
+                on_metrics(step, metrics)
+            if on_eval is not None and eval_every and step % eval_every == 0:
+                with spans.span("eval"):
+                    on_eval(step, state)
 
-        state = new_state
-        step += 1
-        if on_metrics is not None:
-            on_metrics(step, metrics)
-        if on_eval is not None and eval_every and step % eval_every == 0:
-            on_eval(step, state)
-
-        preempted = guard is not None and guard.reached_sync_point(step)
-        if preempted or step % ckpt_every == 0 or step == total_steps:
-            save(step, state)
-        if preempted:
-            report.preempted = True
-            break
+            preempted = guard is not None and guard.reached_sync_point(step)
+            if preempted or step % ckpt_every == 0 or step == total_steps:
+                save(step, state)
+            if preempted:
+                report.preempted = True
+                if flight is not None:
+                    flight.dump(f"preemption (SIGTERM) at step {step}")
+                break
+    except TrainingDiverged:
+        raise  # already dumped above
+    except BaseException as e:
+        # A crash propagating out of the step or the data source: the flight
+        # recorder's last-N trajectory is exactly the postmortem context a
+        # bare traceback loses.
+        if flight is not None:
+            flight.dump(f"crash at step {step}: {type(e).__name__}: {e}")
+        raise
 
     report.final_step = step
     if saver is not None:

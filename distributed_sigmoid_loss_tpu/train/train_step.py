@@ -725,6 +725,7 @@ def make_train_step(
     def step(state: TrainState, batch: dict):
         loss, lp, aux, grads = grads_and_metrics(state.params, batch)
         prev_step = state.step  # apply_gradients increments; EMA warmup wants
+        prev_params = state.params  # update_ratio needs the pre-update tree
         state = state.apply_gradients(grads=grads)  # the 0-based update index
         if zero1:
             # Re-pin the new optimizer state to its ZeRO-1 placement: XLA
@@ -746,11 +747,22 @@ def make_train_step(
                     state.ema, state.params, step=prev_step, decay=ema_decay
                 )
             )
+        # Health scalars (obs/health.py watchdog inputs): param_norm and the
+        # update-to-param ratio. The per-leaf diff is transient (XLA fuses it
+        # into the norm reduction) and the norms are scalar reductions — the
+        # cheap in-step tier; the host-side spike/NaN detection reads these
+        # off the metrics line without any extra device sync.
+        param_norm = optax.global_norm(state.params)
+        update_norm = optax.global_norm(
+            jax.tree.map(lambda n, o: n - o, state.params, prev_params)
+        )
         metrics = {
             "loss": loss,
             "t": jnp.exp(lp["t_prime"]),
             "bias": lp["bias"],
             "grad_norm": optax.global_norm(grads),
+            "param_norm": param_norm,
+            "update_ratio": update_norm / (param_norm + 1e-12),
         }
         if moe_aux_weight is not None:
             metrics["moe_aux"] = aux

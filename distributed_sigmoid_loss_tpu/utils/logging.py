@@ -6,6 +6,7 @@ stack's ``stats()`` snapshots are built on."""
 from __future__ import annotations
 
 import json
+import math
 import sys
 import threading
 import time
@@ -36,14 +37,22 @@ class LatencyWindow:
 
     def percentiles_ms(self, ps: tuple[int, ...] = (50, 95)) -> dict[str, float]:
         """{"p50_ms": ..., "p95_ms": ...} over the retained window (zeros when
-        nothing has been recorded yet — a snapshot must never raise)."""
+        nothing has been recorded yet — a snapshot must never raise).
+
+        Nearest-rank: the p-th percentile of N sorted samples is the one at
+        1-based rank ``ceil(p/100 · N)``, i.e. index ``ceil(p/100·N) − 1``.
+        The previous ``int(N·p/100)`` overshot by one rank — at N=2 the "p50"
+        was the MAX, and small serve windows systematically over-reported
+        their tails (pinned by tests/test_obs.py).
+        """
         with self._lock:
             samples = sorted(self._samples)
         if not samples:
             return {f"p{p}_ms": 0.0 for p in ps}
+        n = len(samples)
         out = {}
         for p in ps:
-            idx = min(len(samples) - 1, max(0, int(len(samples) * p / 100.0)))
+            idx = min(n - 1, max(0, math.ceil(p / 100.0 * n) - 1))
             out[f"p{p}_ms"] = round(samples[idx] * 1000.0, 3)
         return out
 
@@ -53,13 +62,40 @@ class MetricsLogger:
 
     Keeps host-side state only; call with already-materialized scalars so it never
     forces an early device sync inside the step.
+
+    ``schema`` (a field set from ``obs/metrics_schema.py``, with
+    ``schema_prefixes`` for dynamic families like ``eval/``) turns on
+    emit-time validation: an undeclared field warns on stderr but the line
+    still prints — a metric must never be lost to its own validator (the
+    bench ``_emit`` convention; graftlint's ``repo-metrics-schema`` rule is
+    the static tier-1 enforcement of the same registry).
     """
 
-    def __init__(self, stream: IO | None = None, every: int = 1):
+    def __init__(self, stream: IO | None = None, every: int = 1,
+                 schema: frozenset | None = None,
+                 schema_prefixes: tuple = ()):
         self.stream = stream or sys.stdout
         self.every = every
+        self.schema = schema
+        self.schema_prefixes = tuple(schema_prefixes)
         self._last_time: float | None = None
         self._last_step: int | None = None
+
+    def _validate(self, record: Mapping) -> None:
+        if self.schema is None:
+            return
+        from distributed_sigmoid_loss_tpu.obs.metrics_schema import (
+            validate_metrics,
+        )
+
+        problems = validate_metrics(
+            dict(record), fields=self.schema, prefixes=self.schema_prefixes
+        )
+        if problems:
+            print(
+                "WARNING: metrics schema violation: " + "; ".join(problems),
+                file=sys.stderr,
+            )
 
     def log(self, step: int, metrics: Mapping[str, float], *,
             force: bool = False) -> None:
@@ -78,13 +114,34 @@ class MetricsLogger:
                     (step - self._last_step) / (now - self._last_time)
                 )
             self._last_time, self._last_step = now, step
+        self._validate(record)
         self.stream.write(json.dumps(record) + "\n")
         self.stream.flush()
 
-    def write(self, record: Mapping) -> None:
+    def write(self, record: Mapping, schema: frozenset | None = None,
+              schema_prefixes: tuple = ()) -> None:
         """Emit a raw JSON-lines record with no step bookkeeping — for
         structured snapshots (the serving stack's ``stats()``: nested cache /
         histogram dicts) that the scalar ``log`` contract can't carry. The
-        steps/sec clock is untouched, same as ``force=True``."""
-        self.stream.write(json.dumps(dict(record)) + "\n")
+        steps/sec clock is untouched, same as ``force=True``. ``schema``
+        overrides the constructor's (out-of-band records — health events,
+        serve stats — validate against their own registries)."""
+        record = dict(record)
+        if schema is not None:
+            from distributed_sigmoid_loss_tpu.obs.metrics_schema import (
+                validate_metrics,
+            )
+
+            problems = validate_metrics(
+                record, fields=schema, prefixes=schema_prefixes
+            )
+            if problems:
+                print(
+                    "WARNING: metrics schema violation: "
+                    + "; ".join(problems),
+                    file=sys.stderr,
+                )
+        else:
+            self._validate(record)
+        self.stream.write(json.dumps(record) + "\n")
         self.stream.flush()

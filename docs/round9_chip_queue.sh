@@ -1,0 +1,73 @@
+#!/bin/bash
+# Round-9 chip measurement queue — land the queued headline numbers WITH
+# their graftscope evidence attached (spans + device traces + attribution):
+#   nohup bash docs/round9_chip_queue.sh > /tmp/r9queue.log 2>&1 &
+#
+# Same recovery-waiting discipline as rounds 5-8: one bounded probe per cycle
+# until the tunnel answers, then measurements cheapest-first. NEVER signal a
+# running bench process (SIGTERM mid-XLA-compile wedges the tunnel —
+# docs/PERF.md postmortems); the fresh-compile configs below ride the
+# detached compile shield automatically. Every bench record this round
+# carries mfu_est + comm_bytes_* unconditionally (obs/attribution.py), so a
+# measured mfu can be read directly against its static ceiling.
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-8 queue.
+while pgrep -f round8_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+OBS=/tmp/r9_obs
+mkdir -p "$OBS"
+
+set -x
+# 1. bf16 headline anchor (cached compiles) — record now carries
+#    mfu_est/roofline_bound/comm_bytes_*; read measured mfu against the
+#    static ceiling to see how much of the gap is overlap vs arithmetic.
+python bench.py
+# 2. Headline WITH a device trace: --profile writes *.trace.json.gz under
+#    $OBS/headline; `obs summarize` merges it with any host spans offline.
+python bench.py 2048 10 b16 --profile "$OBS/headline"
+# 3. The three queued round-7/8 tracks, now attribution-tagged: their
+#    comm_bytes_* split is the A/B evidence (chunked trades nothing on the
+#    wire; ring-overlap must show IDENTICAL bytes to the serial ring).
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --quant-train int8 --metric-suffix _qt8
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --loss-impl chunked \
+  --metric-suffix _chunked
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --ring-overlap --metric-suffix _ringov
+# 4. Step attribution trace at the ring-overlap config: device capture for
+#    the comm/compute-overlap claim (ppermute spans riding behind the MXU).
+python bench.py 512 5 b16 --ring-overlap --profile "$OBS/ringov" \
+  --metric-suffix _ringov_traced
+# 5. Spanned train smoke on the chip host: host spans + flight recorder +
+#    watchdog + per-line mfu_est/comm_bytes_total/input_wait_frac — the
+#    full graftscope surface on real hardware (synthetic data; cheap).
+python -m distributed_sigmoid_loss_tpu train --steps 30 --batch 256 \
+  --log-every 5 --obs-dir "$OBS/train"
+# 6. Merge + print the unified reports into the queue log.
+python -m distributed_sigmoid_loss_tpu obs summarize "$OBS/train"
+python -m distributed_sigmoid_loss_tpu obs summarize "$OBS/headline" \
+  --merged-out "$OBS/headline_merged.json"
+python -m distributed_sigmoid_loss_tpu obs summarize "$OBS/ringov"
+# 7. Serve stage-tail snapshot: p50/p95/p99 end-to-end AND per stage
+#    (queue_wait/assembly/device/reply) — the serving regression baseline.
+python -m distributed_sigmoid_loss_tpu serve-bench --requests 512 --clients 8

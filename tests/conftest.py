@@ -54,6 +54,7 @@ _STANDARD_MODULES = {
     "test_core_loss",
     "test_data_pipeline",
     "test_distributed_parity",
+    "test_obs",
     "test_pipeline",
     "test_serve",
     "test_streamed_loss",

@@ -370,6 +370,47 @@ def test_slow_suite_without_marker_trips():
     assert _rules_of(missing) == ["repo-slow-marker"]
 
 
+def test_unregistered_metric_field_trips_metrics_schema_rule():
+    """repo-metrics-schema: an undeclared metric field in any registered
+    emitting module trips the rule; declared-only sources stay green — for
+    all three schemas (train line, serve stats, health events)."""
+    bad_train = repo_lint.check_metrics_schema(
+        sources={"train/train_step.py":
+                 'metrics = {"loss": 1, "bogus_metric": 2}\n'}
+    )
+    assert _rules_of(bad_train) == ["repo-metrics-schema"]
+    assert bad_train[0].subject == "train/train_step.py::bogus_metric"
+    assert repo_lint.check_metrics_schema(
+        sources={"train/train_step.py":
+                 'metrics = {"loss": 1, "grad_norm": 2}\n'
+                 'metrics["update_ratio"] = 3\n'}
+    ) == []
+    # logger.log / logger.write dict literals are scanned too
+    assert repo_lint.check_metrics_schema(
+        sources={"cli.py": 'logger.log(1, {"loss": 1, "sneaky": 2})\n'}
+    )[0].subject == "cli.py::sneaky"
+    # serve stats dict (the `snap` convention) validates against SERVE fields
+    bad_serve = repo_lint.check_metrics_schema(
+        sources={"serve/service.py": 'snap = {"qps": 1, "bogus_stat": 2}\n'}
+    )
+    assert [f.subject for f in bad_serve] == ["serve/service.py::bogus_stat"]
+    # health events: the dict a function named `record` returns
+    bad_health = repo_lint.check_metrics_schema(
+        sources={"obs/health.py":
+                 'def record(self):\n'
+                 '    return {"metric": "health_event", "bogus_ev": 1}\n'}
+    )
+    assert [f.subject for f in bad_health] == ["obs/health.py::bogus_ev"]
+    # eval/ prefix family never trips the train schema
+    assert repo_lint.check_metrics_schema(
+        sources={"cli.py": 'logger.log(1, {"eval/i2t_recall@1": 0.5})\n'}
+    ) == []
+
+
+def test_metrics_schema_green_on_shipped_tree():
+    assert repo_lint.check_metrics_schema() == []
+
+
 def test_unregistered_bench_record_field_trips():
     src = 'record = {"metric": "m", "value": 1.0, "bogus_field": 2}\n'
     findings = repo_lint.check_bench_record_fields(src)

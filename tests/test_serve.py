@@ -256,10 +256,26 @@ def test_service_end_to_end_cache_and_stats(engine):
 
         snap = svc.stats()
         for key in ("qps", "latency_ms", "batch_size_hist", "cache",
-                    "compile_count", "bucket_space", "requests"):
+                    "compile_count", "bucket_space", "requests",
+                    "stage_latency_ms"):
             assert key in snap, key
         assert snap["compile_count"] == engine.bucket_space
-        assert set(snap["latency_ms"]) == {"p50_ms", "p95_ms"}
+        assert set(snap["latency_ms"]) == {"p50_ms", "p95_ms", "p99_ms"}
+        # Per-stage tails (graftscope): every batching stage, per modality.
+        assert set(snap["stage_latency_ms"]) == {"text", "image"}
+        assert set(snap["stage_latency_ms"]["text"]) == {
+            "queue_wait", "assembly", "device", "reply"
+        }
+        assert snap["stage_latency_ms"]["text"]["device"]["p99_ms"] >= 0.0
+        # Every snapshot field is declared in the serve schema registry.
+        from distributed_sigmoid_loss_tpu.obs.metrics_schema import (
+            SERVE_STATS_FIELDS,
+            validate_metrics,
+        )
+
+        assert validate_metrics(
+            snap, fields=SERVE_STATS_FIELDS, prefixes=()
+        ) == []
         assert json.dumps(snap)  # snapshot must be JSON-serializable as-is
 
 
@@ -513,8 +529,10 @@ def test_cli_serve_bench_prints_stats_snapshot(tmp_path):
     record = json.loads(proc.stdout.strip().splitlines()[-1])
     assert record["metric"] == "serve_bench"
     assert record["requests"] == 48
-    for key in ("qps", "latency_ms", "batch_size_hist", "cache"):
+    for key in ("qps", "latency_ms", "batch_size_hist", "cache",
+                "stage_latency_ms"):
         assert key in record, key
+    assert "p99_ms" in record["latency_ms"]
     assert 0.0 <= record["cache"]["hit_rate"] <= 1.0
     # The serving contract: compiles == warmed shape buckets, NOT requests.
     assert record["compile_count"] == record["bucket_space"] == 3 * 2
