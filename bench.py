@@ -231,6 +231,46 @@ def _attn_bwd_record_fields(args) -> dict:
     return fields
 
 
+def _pallas_record_fields(args) -> dict:
+    """Pallas-loss record fields from the kernel choice ACTUALLY resolved at
+    trace time, cross-checked against argv.
+
+    ``pallas_compatible`` falls back to the XLA block silently at trace time,
+    so before round 10 a record could claim ``use_pallas: true`` while every
+    block ran the XLA path (exact same class as the round-5 attn_bwd
+    finding). The streaming kernel records every dispatch resolution
+    process-wide (ops/pallas_sigmoid_loss.traced_loss_kernels); the record
+    carries that truth as ``pallas_engaged``, with ``pallas_mismatch`` set
+    (and a stderr warning) whenever any block fell back — so the datapoint
+    never silently enters a per-metric stream under the wrong tag.
+    """
+    if not args.use_pallas:
+        return {}
+    from distributed_sigmoid_loss_tpu.ops.pallas_sigmoid_loss import (
+        traced_loss_kernels,
+    )
+
+    traced = traced_loss_kernels()
+    kinds = [t for t in traced if t != "xla"]
+    fell_back = "xla" in traced or not traced
+    if not kinds:
+        engaged = "none"
+    elif len(kinds) == 1 and not fell_back:
+        engaged = kinds[0]
+    else:
+        engaged = "mixed"
+    fields = {"pallas_engaged": engaged}
+    if fell_back:
+        print(
+            f"WARNING: --use-pallas requested but the traced loss blocks "
+            f"resolved to {traced or ('none',)!r} — at least one block ran "
+            "the XLA fallback; tagging the record pallas_mismatch",
+            file=sys.stderr,
+        )
+        fields["pallas_mismatch"] = True
+    return fields
+
+
 # Flags deliberately OUTSIDE the compile shield, each with its rationale.
 # The graftlint rule `repo-bench-shield` (analysis/repo_lint.py) cross-checks
 # the REAL argparse tree against _fresh_compile_config's reads plus this
@@ -928,6 +968,7 @@ def run_step_breakdown(args) -> int:
     if args.mu_bf16:
         record["adam_mu_dtype"] = "bfloat16"
     record.update(_attn_bwd_record_fields(args))
+    record.update(_pallas_record_fields(args))
     _emit(record)
     return 0
 
@@ -1643,6 +1684,7 @@ def main():
     if args.text_attn_impl:
         record["text_attn_impl"] = args.text_attn_impl
     record.update(_attn_bwd_record_fields(args))
+    record.update(_pallas_record_fields(args))
     if args.moe:
         record["moe_experts"] = args.moe
         record["moe_num_selected"] = args.moe_k

@@ -17,7 +17,8 @@ Public surface (mirrors the reference component inventory, see SURVEY.md §2):
   (reference: rwightman_sigmoid_loss.py ``SigLipLoss``).
 - :mod:`.parallel.ring_attention` — sequence-parallel exact attention over the same
   ppermute ring topology (long-context path).
-- :mod:`.ops.pallas_sigmoid_loss` — fused Pallas TPU kernel for the loss hot op.
+- :mod:`.ops.pallas_sigmoid_loss` — streaming 2-D Pallas TPU kernel (fused
+  backward, int8 MXU path) for the loss hot op.
 - :mod:`.ops.pallas_short_attention` / :mod:`.ops.flash_attention` — fused attention
   kernels for the towers (VMEM-resident short-sequence kernel; blockwise flash for
   long context).

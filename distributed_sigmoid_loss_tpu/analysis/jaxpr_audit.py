@@ -66,8 +66,12 @@ JAXPR_RULES = (
     "jaxpr-bf16-upcast",
 )
 
-# The six step configs the acceptance gate requires coverage of; see
-# step_config_jaxprs for how each is built.
+# The fifteen step configs the acceptance gate requires coverage of (the
+# round-4 six plus the round-10 streaming-pallas compositions); see
+# step_config_jaxprs for how each is built. The pallas_* configs trace at
+# kernel-compatible shapes (embed 128, local_b 8 f32 / 32 int8) so the
+# pallas_call genuinely appears in the audited jaxpr — an incompatible shape
+# would silently audit the XLA fallback instead.
 DEFAULT_STEP_CONFIGS = (
     "fused",
     "chunked",
@@ -75,6 +79,15 @@ DEFAULT_STEP_CONFIGS = (
     "ring_overlap",
     "compressed_dcn",
     "quant_train_int8",
+    "pallas_fused",
+    "pallas_chunked",
+    "pallas_ring",
+    "pallas_ring_overlap",
+    "pallas_int8_fused",
+    "pallas_int8_chunked",
+    "pallas_int8_ring",
+    "pallas_int8_ring_overlap",
+    "compressed_pallas_chunked",
 )
 
 # Collectives that SUM over their named axes: a second application over the
@@ -560,7 +573,7 @@ def audit_jaxpr(
 
 
 # ---------------------------------------------------------------------------
-# The six real step configs, traced abstractly (no compile, no execution).
+# The fifteen real step configs, traced abstractly (no compile, no execution).
 # ---------------------------------------------------------------------------
 
 
@@ -616,9 +629,9 @@ def _abstract_state(model, tx, batch, ef_slices: int | None = None):
 
 
 def step_config_jaxprs(n_devices: int | None = None) -> dict:
-    """label -> (closed_jaxpr, audit_kwargs) for the six step configs, traced
-    on virtual CPU devices. Trace-only: tiny towers, abstract state/batch —
-    seconds, not the minutes a compile would cost."""
+    """label -> (closed_jaxpr, audit_kwargs) for the fifteen step configs,
+    traced on virtual CPU devices. Trace-only: tiny towers, abstract
+    state/batch — seconds, not the minutes a compile would cost."""
     import dataclasses
 
     import jax
@@ -643,7 +656,7 @@ def step_config_jaxprs(n_devices: int | None = None) -> dict:
     if n_devices < 4 or n_devices % 2:
         raise RuntimeError(
             f"the jaxpr audit needs an even mesh of >= 4 devices to cover "
-            f"all six step configs (got {n_devices}; run under "
+            f"all fifteen step configs (got {n_devices}; run under "
             f"--xla_force_host_platform_device_count or lint --cpu-devices)"
         )
     dp_mesh = Mesh(np.asarray(devices[:n_devices]), ("dp",))
@@ -660,62 +673,129 @@ def step_config_jaxprs(n_devices: int | None = None) -> dict:
         text=dataclasses.replace(cfg.text, quant_train="int8"),
     )
     qt_model = SigLIP(qt_cfg)
+    # Streaming-kernel-compatible tiny towers: embed 128 (lane-aligned d) so
+    # the pallas_* configs trace the REAL kernel, not its XLA fallback. The
+    # f32 kernel needs local_b % 8, the int8 path local_b % 32 (int8 sublane
+    # quantum) — hence the two batch sizes below.
+    p_cfg = dataclasses.replace(
+        cfg,
+        vision=dataclasses.replace(cfg.vision, embed_dim=128),
+        text=dataclasses.replace(cfg.text, embed_dim=128),
+    )
+    p_model = SigLIP(p_cfg)
+    pqt_cfg = dataclasses.replace(
+        p_cfg,
+        vision=dataclasses.replace(p_cfg.vision, quant_train="int8"),
+        text=dataclasses.replace(p_cfg.text, quant_train="int8"),
+    )
+    pqt_model = SigLIP(pqt_cfg)
     tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
     batch = _abstract_batch(cfg, 2 * n_devices)
+    p_batch = _abstract_batch(p_cfg, 8 * n_devices)
+    pq_batch = _abstract_batch(pqt_cfg, 32 * n_devices)
     state = _abstract_state(model, tx, batch)
     qt_state = _abstract_state(qt_model, tx, batch)
     ef_state = _abstract_state(model, tx, batch, ef_slices=2)
+    p_state = _abstract_state(p_model, tx, p_batch)
+    pqt_state = _abstract_state(pqt_model, tx, pq_batch)
+    p_ef_state = _abstract_state(p_model, tx, p_batch, ef_slices=2)
 
+    def train(m, mesh, loss_cfg):
+        return lambda: make_train_step(m, mesh, loss_cfg)[0]
+
+    chunk_kw = {"expect_chunk_checkpoint": True}
     builds = {
         "fused": (
-            model, state,
-            lambda: make_train_step(
-                model, dp_mesh, LossConfig(variant="all_gather")
-            )[0],
-            {},
+            state, batch,
+            train(model, dp_mesh, LossConfig(variant="all_gather")), {},
         ),
         "chunked": (
-            model, state,
-            lambda: make_train_step(
-                model, dp_mesh,
-                LossConfig(variant="all_gather", loss_impl="chunked"),
-            )[0],
-            {"expect_chunk_checkpoint": True},
+            state, batch,
+            train(model, dp_mesh,
+                  LossConfig(variant="all_gather", loss_impl="chunked")),
+            chunk_kw,
         ),
-        "ring": (
-            model, state,
-            lambda: make_train_step(model, dp_mesh, LossConfig())[0],
-            {},
-        ),
+        "ring": (state, batch, train(model, dp_mesh, LossConfig()), {}),
         "ring_overlap": (
-            model, state,
-            lambda: make_train_step(
-                model, dp_mesh, LossConfig(ring_overlap=True)
-            )[0],
-            {},
+            state, batch,
+            train(model, dp_mesh, LossConfig(ring_overlap=True)), {},
         ),
         "compressed_dcn": (
-            model, ef_state,
+            ef_state, batch,
             lambda: make_compressed_train_step(
                 model, dcn_mesh, LossConfig(variant="all_gather")
             )[0],
             {},
         ),
         "quant_train_int8": (
-            qt_model, qt_state,
-            lambda: make_train_step(qt_model, dp_mesh, LossConfig())[0],
-            {},
+            qt_state, batch, train(qt_model, dp_mesh, LossConfig()), {},
+        ),
+        # Round-10 streaming-kernel compositions: the kernel as the fused
+        # gathered block, the chunked scan's block body, and the ring's
+        # per-hop block (serial + overlapped), each also through the towers'
+        # int8 STE config (which routes the loss matmul itself through the
+        # kernel's int8 MXU path via resolve_loss_quant).
+        "pallas_fused": (
+            p_state, p_batch,
+            train(p_model, dp_mesh,
+                  LossConfig(variant="all_gather", use_pallas=True)), {},
+        ),
+        "pallas_chunked": (
+            p_state, p_batch,
+            train(p_model, dp_mesh,
+                  LossConfig(variant="all_gather", loss_impl="chunked",
+                             use_pallas=True)),
+            chunk_kw,
+        ),
+        "pallas_ring": (
+            p_state, p_batch,
+            train(p_model, dp_mesh, LossConfig(use_pallas=True)), {},
+        ),
+        "pallas_ring_overlap": (
+            p_state, p_batch,
+            train(p_model, dp_mesh,
+                  LossConfig(ring_overlap=True, use_pallas=True)), {},
+        ),
+        "pallas_int8_fused": (
+            pqt_state, pq_batch,
+            train(pqt_model, dp_mesh,
+                  LossConfig(variant="all_gather", use_pallas=True)), {},
+        ),
+        "pallas_int8_chunked": (
+            pqt_state, pq_batch,
+            train(pqt_model, dp_mesh,
+                  LossConfig(variant="all_gather", loss_impl="chunked",
+                             use_pallas=True)),
+            chunk_kw,
+        ),
+        "pallas_int8_ring": (
+            pqt_state, pq_batch,
+            train(pqt_model, dp_mesh, LossConfig(use_pallas=True)), {},
+        ),
+        "pallas_int8_ring_overlap": (
+            pqt_state, pq_batch,
+            train(pqt_model, dp_mesh,
+                  LossConfig(ring_overlap=True, use_pallas=True)), {},
+        ),
+        "compressed_pallas_chunked": (
+            p_ef_state, p_batch,
+            lambda: make_compressed_train_step(
+                p_model, dcn_mesh,
+                LossConfig(variant="all_gather", loss_impl="chunked",
+                           use_pallas=True),
+            )[0],
+            chunk_kw,
         ),
     }
     out = {}
-    for label, (_, st, build, kwargs) in builds.items():
+    for label, (st, bt, build, kwargs) in builds.items():
         step = build()
-        out[label] = (jax.make_jaxpr(step)(st, batch), kwargs)
+        out[label] = (jax.make_jaxpr(step)(st, bt), kwargs)
     return out
 
 
 def audit_default_step_configs(n_devices: int | None = None) -> list[Finding]:
-    """Audit all six step configs; the tier-1/dryrun entry point."""
+    """Audit all fifteen step configs; the tier-1/dryrun entry point."""
     findings: list[Finding] = []
     for label, (closed, kwargs) in step_config_jaxprs(n_devices).items():
         findings.extend(audit_jaxpr(closed, label=label, **kwargs))

@@ -26,7 +26,7 @@ The subcommands tie the subsystems together:
   figure and a decode worker-scaling curve. CPU-runnable —
   docs/PERF.md "Feeding the headline".
 - ``lint`` — graftlint: the repo-invariant AST linter plus the jaxpr
-  collective/dtype auditor traced over the six real step configs on an
+  collective/dtype auditor traced over the fifteen real step configs on an
   emulated CPU mesh (exit 1 on findings, ``--json``, per-rule ``--disable``).
   The same analyzers run in tier-1 (tests/test_analysis.py) and the dryrun —
   docs/ANALYSIS.md.
@@ -480,6 +480,12 @@ def cmd_train(args) -> int:
               "family only (the softmax ring already streams its logsumexp)",
               file=sys.stderr)
         return 2
+    if args.use_pallas and args.loss_family != "sigmoid":
+        # The streaming kernel computes the sigmoid family's block math; a
+        # softmax run claiming --use-pallas would silently run plain XLA.
+        print("--use-pallas applies to the sigmoid family only",
+              file=sys.stderr)
+        return 2
     if args.watchdog == "skip" and not args.ckpt_dir:
         # The jitted step DONATES its input state, so a poisoned update can
         # only be undone by restoring a checkpoint — skip without --ckpt-dir
@@ -760,7 +766,8 @@ def cmd_train(args) -> int:
                 model,
                 mesh,
                 LossConfig(variant="all_gather", family=args.loss_family,
-                           precision="default", loss_impl=args.loss_impl),
+                           precision="default", loss_impl=args.loss_impl,
+                           use_pallas=args.use_pallas),
                 zero1=args.zero1,
                 compression=args.grad_compression,
                 topk_frac=args.topk_frac,
@@ -792,7 +799,8 @@ def cmd_train(args) -> int:
             LossConfig(variant=variant,
                        family=args.loss_family, precision="default",
                        loss_impl=args.loss_impl,
-                       ring_overlap=args.ring_overlap),
+                       ring_overlap=args.ring_overlap,
+                       use_pallas=args.use_pallas),
             accum_steps=args.accum,
             accum_negatives=args.accum_negatives,
             accum_dtype="bfloat16" if args.accum_bf16 else None,
@@ -1700,7 +1708,7 @@ def cmd_obs(args) -> int:
 
 def cmd_lint(args) -> int:
     """Run graftlint: the repo-invariant AST linter plus (default) the jaxpr
-    collective/dtype auditor over the six real step configs on an emulated
+    collective/dtype auditor over the fifteen real step configs on an emulated
     CPU mesh. Exit 0 = clean, 1 = findings, 2 = usage error.
 
     Rule catalog + allowlist policy: docs/ANALYSIS.md. The same entry points
@@ -1812,6 +1820,15 @@ def main(argv=None) -> int:
                          "ppermute is issued before hop k's block matmuls so "
                          "XLA hides ICI latency behind the MXU (ring variant "
                          "only; bitwise-same accumulation order)")
+    tr.add_argument("--use-pallas", action="store_true",
+                    help="streaming 2-D Pallas loss kernel: every logits "
+                         "block (fused gather, chunked scan body, ring hop) "
+                         "computes tile-by-tile in VMEM with a fused-backward "
+                         "recompute VJP — composes with --loss-impl chunked "
+                         "and --ring-overlap; with --quant-train int8 the "
+                         "block products run the int8 MXU path (STE "
+                         "semantics); falls back to XLA per block for "
+                         "non-tileable shapes (recorded, never silent)")
     tr.add_argument("--loss-family", choices=["sigmoid", "softmax"],
                     default="sigmoid",
                     help="sigmoid = SigLIP (reference); softmax = CLIP/InfoNCE "
@@ -2145,7 +2162,7 @@ def main(argv=None) -> int:
     ln = sub.add_parser(
         "lint",
         help="graftlint: repo-invariant linter + jaxpr collective/dtype "
-             "auditor over the six step configs (exit 1 on findings); "
+             "auditor over the fifteen step configs (exit 1 on findings); "
              "rule catalog in docs/ANALYSIS.md",
     )
     ln.add_argument("--json", action="store_true",
@@ -2156,7 +2173,7 @@ def main(argv=None) -> int:
                          "for the catalog — prefer fixing or allowlisting "
                          "with a rationale over disabling")
     ln.add_argument("--no-jaxpr", action="store_true",
-                    help="AST rules only (skip tracing the six step configs; "
+                    help="AST rules only (skip tracing the fifteen step configs; "
                          "sub-second, for pre-commit-style hooks)")
     ln.add_argument("--cpu-devices", type=int, default=0,
                     help="virtual CPU mesh size for the jaxpr auditor "

@@ -225,6 +225,26 @@ def _walk(jaxpr, bound: dict, mult: float, acc: _Costs) -> None:
                 _walk(body, bound, mult * length, acc)
             continue
 
+        if name == "pallas_call":
+            # The kernel body runs once PER GRID STEP: walk its jaxpr (the
+            # per-tile dots are ordinary dot_general eqns there) with the
+            # grid product as multiplier — closed-form exact for the loss
+            # kernels (grid · 2·tile_b·tile_n·d == 2·b·n·d), the same
+            # trip-count treatment the scan case gives the chunked path.
+            # Leaving it opaque is how mfu_est undercounted every
+            # --use-pallas record before round 10.
+            body = _jaxpr_of(eqn.params.get("jaxpr"))
+            grid = getattr(eqn.params.get("grid_mapping"), "grid", ()) or ()
+            steps = 1.0
+            for g in grid:
+                try:
+                    steps *= float(int(g))
+                except (TypeError, ValueError):
+                    pass  # dynamic grid dim: count the body once (lower bound)
+            if body is not None:
+                _walk(body, bound, mult * max(steps, 1.0), acc)
+            continue
+
         if name == "cond":
             # Branches are alternatives, not a sequence: charge the costliest
             # one (the conservative upper bound for a static estimate).

@@ -125,6 +125,8 @@ def sigmoid_loss_chunk_scan(
     *,
     positive_chunk: jax.Array,
     precision=jax.lax.Precision.HIGHEST,
+    use_pallas: bool = False,
+    quant: str = "",
 ) -> jax.Array:
     """Streamed-negatives loss: ``lax.scan`` over stacked text chunk-blocks.
 
@@ -144,12 +146,37 @@ def sigmoid_loss_chunk_scan(
     parity vs the fused path holds at bf16 grade, f32 parity at rtol 1e-5.
     Returns the summed xent over all chunks, divided by ``n_img`` — the same
     local-batch normalization as :func:`sigmoid_loss_block`.
+
+    ``use_pallas=True`` makes the streaming 2-D Pallas kernel the chunk-block
+    body (per-block logits→softplus→reduce stays on-chip; its custom VJP
+    recomputes tiles, so the checkpoint'd backward never materializes even
+    one block's logits), with ``quant="int8"`` routing each block product
+    through the int8 MXU path. Shapes that fail the kernel's tiling
+    constraints fall back to the XLA block — the fallback is RECORDED
+    (ops.pallas_sigmoid_loss.traced_loss_kernels) so a bench record can
+    never silently claim kernel engagement.
     """
     n_img = zimg.shape[0]
     num_chunks = txt_chunks.shape[0]
 
     def body(acc, inputs):
         k, chunk = inputs
+        if use_pallas:
+            from distributed_sigmoid_loss_tpu.ops.pallas_sigmoid_loss import (
+                NEGATIVE_ONLY_OFFSET,
+                streaming_block_loss_or_none,
+            )
+
+            # The positive diagonal lives on chunk `positive_chunk` (traced):
+            # offset 0 there, the never-matching sentinel elsewhere.
+            off = jnp.where(
+                k == positive_chunk, 0.0, float(NEGATIVE_ONLY_OFFSET)
+            ).astype(jnp.float32)
+            total = streaming_block_loss_or_none(
+                zimg, chunk, t_prime, bias, off, quant=quant, normalize=False
+            )
+            if total is not None:  # static: same shapes every chunk
+                return acc + total.astype(jnp.float32), None
         logits = pairwise_logits(zimg, chunk, t_prime, bias, precision=precision)
         rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)

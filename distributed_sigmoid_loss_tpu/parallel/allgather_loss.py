@@ -44,6 +44,7 @@ def allgather_sigmoid_loss(
     precision=lax.Precision.HIGHEST,
     use_pallas: bool = False,
     loss_impl: str = "fused",
+    quant: str = "",
 ) -> jax.Array:
     """Per-shard loss of the all-gather variant; call inside ``shard_map``.
 
@@ -58,6 +59,14 @@ def allgather_sigmoid_loss(
         (:func:`~distributed_sigmoid_loss_tpu.ops.sigmoid_loss.sigmoid_loss_chunk_scan`)
         so the full logits matrix is NEVER materialized — peak loss HBM drops
         ~W×, which is what unlocks larger ``per_chip_batch`` at big W.
+      use_pallas: run each logits block through the streaming 2-D Pallas
+        kernel (ops/pallas_sigmoid_loss.py). Composes with BOTH loss_impls:
+        the fused path hands the kernel the whole gathered block (streamed
+        tile-by-tile, so nothing beyond one tile is VMEM-resident), the
+        chunked path uses it as the scan's chunk-block body.
+      quant: ``"int8"`` (with use_pallas) routes the block products through
+        the int8 MXU path — forward per-element bit-identical to
+        ops.quant.int8_dot_general, backward the full-precision STE VJP.
 
     Returns the scalar per-shard loss, normalized by local batch size — identical
     placement of the normalization as the reference (distributed_sigmoid_loss.py:47), so
@@ -68,12 +77,6 @@ def allgather_sigmoid_loss(
     w = lax.axis_size(axis_name)
 
     if loss_impl == "chunked":
-        if use_pallas:
-            raise ValueError(
-                "loss_impl='chunked' streams the gathered negatives block-by-"
-                "block; the fused pallas kernel computes the whole gathered "
-                "matmul — pick one"
-            )
         # (W, local_b, d) stacked in axis-index order IS the chunk layout; the
         # positive diagonal lives on this shard's own chunk (i == rank).
         return sigmoid_loss_chunk_scan(
@@ -83,6 +86,8 @@ def allgather_sigmoid_loss(
             bias,
             positive_chunk=lax.axis_index(axis_name),
             precision=precision,
+            use_pallas=use_pallas,
+            quant=quant,
         )
     if loss_impl != "fused":
         raise ValueError(f"unknown loss_impl: {loss_impl!r}")
@@ -93,12 +98,13 @@ def allgather_sigmoid_loss(
 
     if use_pallas:
         from distributed_sigmoid_loss_tpu.ops.pallas_sigmoid_loss import (
-            fused_block_loss_or_none,
+            streaming_block_loss_or_none,
         )
 
         idx = lax.axis_index(axis_name)
-        fused = fused_block_loss_or_none(
-            zimg, all_txt, t_prime, bias, (idx * local_b).astype(jnp.float32)
+        fused = streaming_block_loss_or_none(
+            zimg, all_txt, t_prime, bias, (idx * local_b).astype(jnp.float32),
+            quant=quant,
         )
         if fused is not None:
             return fused

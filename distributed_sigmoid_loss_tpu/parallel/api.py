@@ -35,6 +35,7 @@ def make_per_shard_loss(
     use_pallas: bool = False,
     loss_impl: Literal["fused", "chunked"] = "fused",
     ring_overlap: bool = False,
+    quant: str = "",
 ) -> Callable:
     """The ONE family/variant dispatch, shared by :func:`make_sharded_loss_fn`
     and the train step — returns ``per_shard(zimg, ztxt, t_prime, bias)`` for
@@ -45,9 +46,15 @@ def make_per_shard_loss(
     negatives chunk-by-chunk instead of materializing the full
     ``(local_b, W·local_b)`` logits; ``ring_overlap=True`` (ring sigmoid only)
     double-buffers the hop loop so the ppermute rides behind the block
-    matmuls. Flag/variant mismatches REFUSE rather than silently no-op — a
-    record or run claiming a memory/overlap recipe that never executed is the
-    config drift these checks exist to prevent.
+    matmuls. ``use_pallas`` (sigmoid, any variant/impl) makes the streaming
+    2-D Pallas kernel the block body — since the kernel never materializes
+    more than one tile, it composes with the chunked scan and the ring's
+    per-hop blocks (the round-7 "memory-optimal OR kernel-fast" refusal is
+    gone); ``quant="int8"`` (with use_pallas) runs the block products on the
+    int8 MXU path (STE semantics). Remaining flag/variant mismatches REFUSE
+    rather than silently no-op — a record or run claiming a memory/overlap
+    recipe that never executed is the config drift these checks exist to
+    prevent.
     """
     if family not in ("sigmoid", "softmax"):
         raise ValueError(f"unknown family: {family!r}")
@@ -70,13 +77,17 @@ def make_per_shard_loss(
             "loss_impl/ring_overlap apply to the sigmoid family only (the "
             "softmax ring already streams its logsumexp)"
         )
-    if use_pallas and loss_impl == "chunked":
-        # Same check lives in allgather_sigmoid_loss for direct callers;
-        # raising HERE keeps it a build-time error, not a trace-time one.
+    if quant not in ("", "int8"):
+        raise ValueError(f"unknown loss quant: {quant!r}")
+    if quant and not use_pallas:
+        # Refuse, don't drop: the int8 loss matmul lives in the streaming
+        # kernel — without it the flag would silently run full precision.
         raise ValueError(
-            "use_pallas fuses the whole gathered block; loss_impl='chunked' "
-            "streams it — pick one"
+            "quant='int8' for the loss requires use_pallas (the int8 MXU "
+            "block product is the streaming kernel's; the XLA path has none)"
         )
+    if quant and family != "sigmoid":
+        raise ValueError("loss quant applies to the sigmoid family only")
 
     if family == "softmax":
         from distributed_sigmoid_loss_tpu.parallel.contrastive import (
@@ -101,12 +112,12 @@ def make_per_shard_loss(
         return partial(
             allgather_sigmoid_loss,
             axis_name=axis_name, precision=precision, use_pallas=use_pallas,
-            loss_impl=loss_impl,
+            loss_impl=loss_impl, quant=quant,
         )
     return partial(
         ring_sigmoid_loss,
         axis_name=axis_name, bidir=bidir, precision=precision,
-        use_pallas=use_pallas, overlap=ring_overlap,
+        use_pallas=use_pallas, overlap=ring_overlap, quant=quant,
     )
 
 
@@ -121,6 +132,7 @@ def make_sharded_loss_fn(
     use_pallas: bool = False,
     loss_impl: Literal["fused", "chunked"] = "fused",
     ring_overlap: bool = False,
+    quant: str = "",
     jit: bool = True,
 ) -> Callable:
     """Build ``loss_fn(params, zimg, ztxt) -> scalar`` over global arrays.
@@ -146,7 +158,7 @@ def make_sharded_loss_fn(
     per_shard = make_per_shard_loss(
         family=family, variant=variant, axis_name=axis_name, bidir=bidir,
         precision=precision, use_pallas=use_pallas, loss_impl=loss_impl,
-        ring_overlap=ring_overlap,
+        ring_overlap=ring_overlap, quant=quant,
     )
 
     def shard_loss(params, zimg, ztxt):

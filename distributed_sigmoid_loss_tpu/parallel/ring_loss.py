@@ -50,6 +50,7 @@ def ring_sigmoid_loss(
     precision=lax.Precision.HIGHEST,
     use_pallas: bool = False,
     overlap: bool = False,
+    quant: str = "",
 ) -> jax.Array:
     """Per-shard loss of the ring variant; call inside ``shard_map``.
 
@@ -62,6 +63,11 @@ def ring_sigmoid_loss(
     :func:`~distributed_sigmoid_loss_tpu.parallel.collectives.double_buffered_scan`)
     so XLA can hide the ICI transfer behind the MXU. The accumulation order is
     UNCHANGED, so the overlapped ring is bitwise-comparable to the serial one.
+
+    ``use_pallas=True`` makes the streaming 2-D Pallas kernel the per-hop
+    block body (serial AND overlapped hop loops — both route through
+    ``block``); ``quant="int8"`` additionally runs each block product on the
+    int8 MXU path (STE semantics, ops/quant.py).
     """
     def block(ztxt_chunk, negative_only):
         if use_pallas:
@@ -69,11 +75,13 @@ def ring_sigmoid_loss(
 
             from distributed_sigmoid_loss_tpu.ops.pallas_sigmoid_loss import (
                 NEGATIVE_ONLY_OFFSET,
-                fused_block_loss_or_none,
+                streaming_block_loss_or_none,
             )
 
             offset = jnp.float32(NEGATIVE_ONLY_OFFSET if negative_only else 0.0)
-            fused = fused_block_loss_or_none(zimg, ztxt_chunk, t_prime, bias, offset)
+            fused = streaming_block_loss_or_none(
+                zimg, ztxt_chunk, t_prime, bias, offset, quant=quant
+            )
             if fused is not None:
                 return fused
         return sigmoid_loss_block(

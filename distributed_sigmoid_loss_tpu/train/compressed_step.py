@@ -247,7 +247,10 @@ def make_compressed_train_step(
         )
     axis = loss_cfg.axis_name
     from distributed_sigmoid_loss_tpu.parallel.api import make_per_shard_loss
-    from distributed_sigmoid_loss_tpu.train.train_step import _precision
+    from distributed_sigmoid_loss_tpu.train.train_step import (
+        _precision,
+        resolve_loss_quant,
+    )
 
     per_shard = make_per_shard_loss(
         family=loss_cfg.family, variant="all_gather",
@@ -255,9 +258,14 @@ def make_compressed_train_step(
         precision=_precision(loss_cfg.precision),
         # Streamed negatives compose: the chunked scan runs over the joint
         # (dcn, dp) gather's W chunks inside this already-unchecked manual
-        # region. ring_overlap is deliberately NOT threaded — this step is
-        # all-gather-only (make_per_shard_loss would refuse it anyway).
+        # region, with the streaming Pallas kernel as its block body when
+        # use_pallas is on (quant derived from the towers, same resolver as
+        # make_train_step). ring_overlap is deliberately NOT threaded — this
+        # step is all-gather-only (make_per_shard_loss would refuse it
+        # anyway).
         loss_impl=loss_cfg.loss_impl,
+        use_pallas=loss_cfg.use_pallas,
+        quant=resolve_loss_quant(model, loss_cfg),
     )
 
     def local_loss(params, images, tokens):

@@ -32,8 +32,31 @@ from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
 __all__ = [
     "make_optimizer", "create_train_state", "init_params", "make_train_step",
     "zero1_constrain", "is_pp_block_leaf", "validate_trainable_quant",
-    "TrainState",
+    "resolve_loss_quant", "TrainState",
 ]
+
+
+def resolve_loss_quant(model: nn.Module, loss_cfg) -> str:
+    """THE loss-matmul quantization resolution, shared by the regular and
+    compressed step builders: ``"int8"`` when the towers train through the
+    int8 STE (``quant_train="int8"``) AND the streaming Pallas loss kernel is
+    on — so ``--quant-train int8`` reaches the loss matmul itself, with the
+    same contract as every other STE dot (forward bit-identical to the
+    inference int8 product, backward the full-precision VJP). Without
+    ``use_pallas`` the loss stays full-precision (the XLA path has no int8
+    block product), matching the pre-streaming behavior.
+    """
+    if not getattr(loss_cfg, "use_pallas", False):
+        return ""
+    from distributed_sigmoid_loss_tpu.utils.config import tower_quant_mode
+
+    cfg = getattr(model, "cfg", None)
+    modes = {
+        tower_quant_mode(tcfg)
+        for tcfg in (getattr(cfg, "vision", None), getattr(cfg, "text", None))
+        if tcfg is not None
+    }
+    return "int8" if "int8_ste" in modes else ""
 
 
 def validate_trainable_quant(model: nn.Module) -> None:
@@ -542,6 +565,7 @@ def make_train_step(
         bidir=loss_cfg.bidir, precision=precision,
         use_pallas=loss_cfg.use_pallas, loss_impl=loss_cfg.loss_impl,
         ring_overlap=loss_cfg.ring_overlap,
+        quant=resolve_loss_quant(model, loss_cfg),
     )
     # See parallel/api.py: the pallas interpreter and the chunked scan's
     # replicated-init carry both need the replication check off.
@@ -560,10 +584,12 @@ def make_train_step(
         out_specs=P(),
         check_vma=loss_check_vma,
     )
-    if loss_cfg.loss_impl == "chunked":
+    if loss_cfg.loss_impl == "chunked" or loss_cfg.use_pallas:
         # Grads of the chunk scan must flow through a JITTED shard_map: the
-        # 0.4.x eager/inline transpose cannot type the scan's scalar carry
-        # (_jax_compat target). jit-in-jit is a free pjit inline on >= 0.6.
+        # 0.4.x eager/inline transpose cannot type the scan's scalar carry —
+        # and the same inline transpose mis-specs the pallas custom_vjp's
+        # scalar residuals (_jax_compat target). jit-in-jit is a free pjit
+        # inline on >= 0.6.
         sharded_loss = jax.jit(sharded_loss)
 
     if accum_negatives not in ("local", "global"):
@@ -663,7 +689,7 @@ def make_train_step(
         out_specs=P(),
         check_vma=loss_check_vma,
     )
-    if loss_cfg.loss_impl == "chunked":
+    if loss_cfg.loss_impl == "chunked" or loss_cfg.use_pallas:
         stacked_loss = jax.jit(stacked_loss)  # same 0.4.x transpose contract
 
     def grads_and_metrics_cached(params, batch):

@@ -26,7 +26,12 @@ class LossConfig:
     axis_name: str = "dp"
     # HIGHEST = fp32 accumulation for parity gates; DEFAULT = bf16 for throughput.
     precision: str = "highest"
-    # Fused Pallas loss kernel (falls back to XLA for non-tileable shapes).
+    # Streaming 2-D Pallas loss kernel: every logits block (fused gather,
+    # chunked scan body, ring hop) computes tile-by-tile in VMEM with a
+    # fused-backward recompute VJP; with quant_train="int8" towers the block
+    # products run the int8 MXU path. Composes with loss_impl="chunked" and
+    # ring_overlap; falls back to XLA per block for non-tileable shapes
+    # (recorded at trace time, never silent).
     use_pallas: bool = False
     # "chunked" (all_gather sigmoid only): stream the gathered negatives
     # through a lax.scan over W chunk-blocks instead of one fused

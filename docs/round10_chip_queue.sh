@@ -1,0 +1,97 @@
+#!/bin/bash
+# Round-10 chip measurement queue — price the streaming 2-D Pallas loss
+# kernel (fused backward, int8 MXU path, chunked∕pallas unification) and
+# drive the queued _32k_equiv recipe to a driver-verified number:
+#   nohup bash docs/round10_chip_queue.sh > /tmp/r10queue.log 2>&1 &
+#
+# Same recovery-waiting discipline as rounds 5-9: one bounded probe per
+# cycle until the tunnel answers, then measurements cheapest-first. NEVER
+# signal a running bench process (SIGTERM mid-XLA-compile wedges the tunnel
+# — docs/PERF.md postmortems); every --use-pallas config below is a
+# fresh-compile config and rides the detached compile shield automatically.
+# Every record carries pallas_engaged/pallas_mismatch (the trace-time truth
+# — a record claiming use_pallas while every block fell back is flagged,
+# never silent) next to mfu_est/comm_bytes_* (now attribution-exact under
+# --use-pallas: the FLOP walk multiplies the kernel jaxpr by its grid).
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-9 queue.
+while pgrep -f round9_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+OBS=/tmp/r10_obs
+mkdir -p "$OBS"
+
+set -x
+# 1. bf16 headline anchor (cached compiles) — the baseline every A/B below
+#    compares against; the perf stream's last verified number is r3's
+#    761.74 pairs/s/chip, so landing ANY real number here is part of the
+#    round, not an afterthought.
+python bench.py
+# 2. Streaming-kernel headline A/B: same recipe ± --use-pallas. Round 2
+#    measured the OLD (forward-only, VMEM-resident-image) kernel as a wash;
+#    this one brings the fused backward — the backward share of the loss
+#    island is where the delta lives. Check pallas_engaged=streaming in the
+#    record before reading the number.
+python bench.py 2048 10 b16 --use-pallas --metric-suffix _pallas
+# 3. The unification A/B: streaming kernel AS the chunk-block body vs the
+#    XLA chunk scan (round 7's recipe). Memory shape identical; the delta
+#    is pure block-kernel speed.
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --loss-impl chunked \
+  --metric-suffix _chunked_xla
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --loss-impl chunked \
+  --use-pallas --metric-suffix _chunked_pallas
+# 4. int8 loss gear: STE towers alone vs STE towers + the loss matmul on
+#    the int8 MXU path (the round-10 addition — resolve_loss_quant routes
+#    --quant-train int8 into the kernel when --use-pallas is on).
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --quant-train int8 --metric-suffix _qt8
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --quant-train int8 --use-pallas \
+  --metric-suffix _qt8_pallas
+# 5. Ring + overlap with the kernel as the hop-block body: the ICI hops
+#    hide behind kernel tiles instead of XLA blocks (comm_bytes_* must be
+#    IDENTICAL to the serial ring's — overlap changes scheduling, not wire).
+python bench.py 2048 5 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --ring-overlap --use-pallas \
+  --metric-suffix _ringov_pallas
+# 6. THE _32k_equiv recipe, driver-verified: 4096/chip (32k global on a
+#    v5e-8) as 32 microbatches of 128 — the shape the round-3 kernel could
+#    NEVER ride (its resident image block alone is 12.6 MB > VMEM budget;
+#    docs/PERF.md "VMEM budget math"). Streaming kernel + chunked scan keep
+#    both the loss HBM (no logits matrix) and the loss VMEM (~1.3 MB/step)
+#    flat at this shape. bf16 first, then the int8 gear on top.
+python bench.py 4096 5 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --loss-impl chunked \
+  --use-pallas --metric-suffix _32k_equiv
+python bench.py 4096 5 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --loss-impl chunked \
+  --use-pallas --quant-train int8 --metric-suffix _32k_equiv_qt8
+# 7. Device trace of the winning pallas config for the attribution story
+#    (kernel spans vs the XLA fusion they replace); merge offline.
+python bench.py 512 5 b16 --use-pallas --profile "$OBS/pallas" \
+  --metric-suffix _pallas_traced
+python -m distributed_sigmoid_loss_tpu obs summarize "$OBS/pallas"
+# 8. Loss-island isolation at the 32k shape: --step-breakdown threads
+#    --use-pallas/--loss-impl, so loss_island_ms prices the kernel directly.
+python bench.py 4096 5 b16 --step-breakdown --variant all_gather \
+  --loss-impl chunked --use-pallas

@@ -2,8 +2,9 @@
 and every rule is falsified on a known-bad fixture (no rule ships untested —
 a rule that cannot fire is a rule that silently stopped protecting anything).
 
-Standard tier: the jaxpr audit is trace-only (no compile) — the six-config
-sweep runs in ~10 s on this host; everything else is AST/pure-python.
+Standard tier: the jaxpr audit is trace-only (no compile) — the
+fifteen-config sweep runs in ~22 s on this host; everything else is
+AST/pure-python.
 """
 
 import json
@@ -183,16 +184,20 @@ def test_bf16_upcast_trips_and_preferred_element_type_passes():
 
 
 # ---------------------------------------------------------------------------
-# the real programs audit green, covering all six step configs
+# the real programs audit green, covering all fifteen step configs
 # ---------------------------------------------------------------------------
 
 
-def test_six_step_configs_audit_green_and_cover_all_paths():
+def test_fifteen_step_configs_audit_green_and_cover_all_paths():
     jaxprs = jaxpr_audit.step_config_jaxprs()
     assert set(jaxprs) == set(jaxpr_audit.DEFAULT_STEP_CONFIGS)
     assert set(jaxprs) == {
         "fused", "chunked", "ring", "ring_overlap", "compressed_dcn",
         "quant_train_int8",
+        "pallas_fused", "pallas_chunked", "pallas_ring",
+        "pallas_ring_overlap", "pallas_int8_fused", "pallas_int8_chunked",
+        "pallas_int8_ring", "pallas_int8_ring_overlap",
+        "compressed_pallas_chunked",
     }
     all_findings = []
     for label, (closed, kwargs) in jaxprs.items():
@@ -200,7 +205,9 @@ def test_six_step_configs_audit_green_and_cover_all_paths():
     assert all_findings == [], [str(f) for f in all_findings]
     # The audit is load-bearing only if the programs actually contain the
     # comm structure it checks: the ring configs must carry ppermutes, the
-    # all-gather ones all_gathers, chunked a remat'd scan.
+    # all-gather ones all_gathers, chunked a remat'd scan — and every
+    # pallas_* config a REAL pallas_call (an incompatible trace shape would
+    # silently audit the XLA fallback instead of the new composition).
     def prims(closed):
         out = set()
 
@@ -218,6 +225,56 @@ def test_six_step_configs_audit_green_and_cover_all_paths():
     assert "all_gather" in prims(jaxprs["fused"][0])
     assert "all_gather" in prims(jaxprs["chunked"][0])
     assert "psum" in prims(jaxprs["compressed_dcn"][0])
+    for label in jaxpr_audit.DEFAULT_STEP_CONFIGS:
+        if "pallas" not in label:
+            continue
+        p = prims(jaxprs[label][0])
+        assert "pallas_call" in p, f"{label} traced without the kernel"
+        if "ring" in label:
+            assert "ppermute" in p
+        else:
+            assert "all_gather" in p
+
+
+def test_pallas_chunk_scan_without_checkpoint_trips():
+    """Known-bad fixture for the NEW composition (ANALYSIS.md falsification
+    policy): a chunk scan whose body is the streaming Pallas kernel but NOT
+    jax.checkpoint'd must trip jaxpr-chunk-checkpoint — the dot the rule
+    hunts for lives inside the pallas_call's kernel jaxpr, so this pins that
+    the detection recurses into kernels rather than only spotting top-level
+    dot_generals."""
+    from distributed_sigmoid_loss_tpu.ops.pallas_sigmoid_loss import (
+        streaming_block_loss_sum,
+    )
+
+    mesh = _mesh8()
+
+    def chunk_loss(checkpointed):
+        def raw_body(carry, c):
+            acc, z = carry
+            s = streaming_block_loss_sum(
+                z, c, jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0),
+                "", 8, 8, True,
+            )
+            return (acc + s, z), None
+
+        def fn(z):
+            body = jax.checkpoint(raw_body) if checkpointed else raw_body
+            (out, _), _ = lax.scan(body, (0.0, z), lax.all_gather(z, "dp"))
+            return out
+
+        return shard_map(
+            fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P(),
+            check_vma=False,
+        )
+
+    x = jnp.ones((64, 128))  # local (8, 128): kernel-compatible tiles
+    assert _audit_rules(
+        jax.jit(chunk_loss(False)), x, expect_chunk_checkpoint=True
+    ) == ["jaxpr-chunk-checkpoint"]
+    assert _audit_rules(
+        jax.jit(chunk_loss(True)), x, expect_chunk_checkpoint=True
+    ) == []
 
 
 def test_rule_catalogs_agree():

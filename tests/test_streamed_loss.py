@@ -198,10 +198,11 @@ def test_flag_conflicts_refused():
         make_per_shard_loss(family="softmax", loss_impl="chunked")
     with pytest.raises(ValueError, match="sigmoid family only"):
         make_per_shard_loss(family="softmax", variant="ring", ring_overlap=True)
-    with pytest.raises(ValueError, match="pick one"):
-        make_per_shard_loss(
-            variant="all_gather", loss_impl="chunked", use_pallas=True
-        )
+    # Round 10 REMOVED the use_pallas×chunked refusal: the streaming 2-D
+    # kernel is the chunk-block body now (tests/test_pallas_loss.py pins the
+    # parity); the build must accept the composition.
+    make_per_shard_loss(variant="all_gather", loss_impl="chunked",
+                        use_pallas=True)
     with pytest.raises(ValueError, match="unknown loss_impl"):
         make_per_shard_loss(variant="all_gather", loss_impl="streamed")
 
