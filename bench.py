@@ -121,6 +121,8 @@ def _metric_for_mode(args) -> tuple[str, str]:
     always see the name the bench that never ran would have used."""
     if getattr(args, "data_bench", False):
         return "data_bench_pipeline_pairs_per_sec", "pairs/s"
+    if getattr(args, "serve_bench", False):
+        return "serve_bench", "req/s"
     if getattr(args, "eval_throughput", False):
         return (
             f"siglip_vit{args.model}_eval_pairs_per_sec_per_chip",
@@ -298,6 +300,11 @@ _SHIELD_EXEMPT_FLAGS = {
     "moe_cf": "only meaningful with --moe (shield trigger)",
     "data_workers": "host-side worker-pool size only (decode/generation "
                     "threads); the compiled programs are byte-identical",
+    "index_tier": "only meaningful with --serve-bench, which is already a "
+                  "shield trigger (enforced: refused without it)",
+    "swap_every": "only meaningful with --serve-bench (shield trigger); "
+                  "host-side churn cadence, and the swap path is "
+                  "recompile-free by contract",
 }
 
 
@@ -340,6 +347,10 @@ def _fresh_compile_config(args) -> bool:
         # data-bench jits the augment/commit programs — tiny, but none of
         # them sit in the warm cache of routine headline runs.
         or args.data_bench
+        # serve-bench warms one engine program per shape bucket (plus the
+        # sharded tier's fan-out program) — fresh compiles, none of them in
+        # the headline warm cache.
+        or args.serve_bench
         or args.use_pallas
         or args.variant != "ring"
         or args.loss_family != "sigmoid"
@@ -1103,6 +1114,43 @@ def run_data_bench_mode(args) -> int:
     return run_data_bench(ns)
 
 
+def run_serve_bench_mode(args) -> int:
+    """--serve-bench: delegate to the cli serve-bench runner (the same code
+    path as the CPU-runnable `python -m distributed_sigmoid_loss_tpu
+    serve-bench`), mapping the bench positionals onto its surface: batch x
+    steps → total client requests, model → tower config. The runner emits
+    the schema-validated serve_bench record itself and exits non-zero if any
+    request escapes the warmed bucket grid (the zero-recompile gate, which
+    --swap-every churn must also hold)."""
+    from distributed_sigmoid_loss_tpu.cli import cmd_serve_bench
+
+    ns = argparse.Namespace(
+        requests=max(args.batch * args.steps, 1), clients=8,
+        model=args.model, batch_buckets="1,8,32", max_wait_ms=5.0,
+        max_queue=1024, cache_size=4096, pool=64,
+        index_size=256, topk=10, seed=0, mesh=False, cpu_devices=0,
+        index_tier=args.index_tier, swap_every=args.swap_every, rerank_k=0,
+    )
+    if args.index_tier == "sharded":
+        import jax
+
+        # The sharded tier partitions the corpus over the dp mesh; on a
+        # 1-chip host the mesh is a single shard, which measures nothing.
+        n_dev = len(jax.devices())
+        if n_dev > 1:
+            ns.mesh = True
+            # The sharded engine needs every bucket to divide the dp axis.
+            ns.batch_buckets = f"{n_dev},{4 * n_dev}"
+        else:
+            print(
+                "WARNING: --index-tier sharded on a 1-device host falls "
+                "back to the exact tier (nothing to shard over)",
+                file=sys.stderr,
+            )
+            ns.index_tier = "exact"
+    return cmd_serve_bench(ns)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     # 288/chip, save_hot remat, unrolled layers is the measured single-chip sweet
@@ -1249,6 +1297,22 @@ def main():
                     help="with --data-bench: host decode/generation worker "
                          "threads (0 = auto: cpu_count minus the "
                          "prefetch/main threads; resolved value recorded)")
+    ap.add_argument("--serve-bench", action="store_true",
+                    help="online-serving bench INSTEAD of the train bench: "
+                         "the cli serve-bench runner on the chip host "
+                         "(requests = batch x steps, 8 client threads; "
+                         "engine warmup compiles one program per shape "
+                         "bucket) — tier A/Bs via --index-tier, hot-swap "
+                         "churn via --swap-every (docs/SERVING.md)")
+    ap.add_argument("--index-tier", default="exact",
+                    choices=["exact", "sharded", "ann"],
+                    help="with --serve-bench: retrieval tier answering the "
+                         "search traffic (sharded needs a multi-chip mesh; "
+                         "ann records measured recall@k)")
+    ap.add_argument("--swap-every", type=int, default=0, metavar="N",
+                    help="with --serve-bench: hot-swap weights + index "
+                         "segments after every N client ops (0 = off); "
+                         "swap latency percentiles land in the record")
     ap.add_argument("--context", type=int, default=0, metavar="SEQ",
                     help="long-context attention bench INSTEAD of the train "
                          "bench: time one transformer block fwd+bwd at this "
@@ -1303,6 +1367,7 @@ def main():
         "--moe-breakdown": args.moe_breakdown,
         "--step-breakdown": args.step_breakdown,
         "--data-bench": args.data_bench,
+        "--serve-bench": args.serve_bench,
     }
     picked_modes = [k for k, v in modes.items() if v]
     if len(picked_modes) > 1:
@@ -1373,6 +1438,47 @@ def main():
         ap.error("--data-workers applies to --data-bench only (the train "
                  "bench generates batches on-device; the CLI train "
                  "subcommand has its own --data-workers)")
+    if args.serve_bench:
+        # The serving bench never builds the train step: refuse, don't drop,
+        # every flag that would claim to change it (the honest-records rule
+        # of every other mode). Honored: batch/steps/model positionals +
+        # --index-tier / --swap-every.
+        unsupported = {
+            "--accum": args.accum != 1, "--zero1": args.zero1,
+            "--mu-bf16": args.mu_bf16, "--accum-bf16": args.accum_bf16,
+            "--remat-policy": bool(args.remat_policy),
+            "--metric-suffix": bool(args.metric_suffix),
+            "--no-text-remat": args.no_text_remat,
+            "--steps-per-call": args.steps_per_call != 1,
+            "--use-pallas": args.use_pallas,
+            "--variant": args.variant != "ring",
+            "--loss-family": args.loss_family != "sigmoid",
+            "--precision": args.precision != "default",
+            "--accum-negatives": args.accum_negatives != "local",
+            "--gradcache-bf16": args.gradcache_bf16,
+            "--attn-bwd": args.attn_bwd != "loop",
+            "--attn-impl": args.attn_impl != "auto",
+            "--text-attn-impl": bool(args.text_attn_impl),
+            "--scan-layers": args.scan_layers,
+            "--moe": bool(args.moe),
+            "--quant": bool(args.quant),
+            "--quant-train": bool(args.quant_train),
+            "--loss-impl": args.loss_impl != "fused",
+            "--ring-overlap": args.ring_overlap,
+            "--profile": bool(args.profile),
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if bad:
+            ap.error(f"--serve-bench does not support {' '.join(bad)} "
+                     "(it measures the online serving stack, not the train "
+                     "step)")
+    else:
+        if args.index_tier != "exact":
+            ap.error("--index-tier without --serve-bench would be a silent "
+                     "no-op")
+        if args.swap_every:
+            ap.error("--swap-every without --serve-bench would be a silent "
+                     "no-op")
     if args.steps_per_call < 1 or args.steps % args.steps_per_call:
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")
@@ -1422,6 +1528,8 @@ def main():
 
     if args.data_bench:
         return run_data_bench_mode(args)
+    if args.serve_bench:
+        return run_serve_bench_mode(args)
     if args.eval_throughput:
         return run_eval_throughput(args)
     if args.context:

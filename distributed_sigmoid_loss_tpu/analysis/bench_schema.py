@@ -86,6 +86,13 @@ BENCH_RECORD_FIELDS = frozenset(
         "items_per_sec", "latency_ms", "batch_size_hist", "stage_latency_ms",
         "rejected", "timeouts", "compile_count", "bucket_space", "index_size",
         "cache",
+        # serve/distindex (RetrievalRouter through cmd_serve_bench): the
+        # retrieval tier + churn-mode invocation fields and the router's
+        # stats fields the snapshot spread carries (mirrored from
+        # obs/metrics_schema.py SERVE_STATS_FIELDS).
+        "index_tier", "swap_every", "index_version", "shard_count",
+        "swap_count", "swap_latency_ms", "recall_at_k", "rerank_k",
+        "search_stage_latency_ms",
     )
 )
 

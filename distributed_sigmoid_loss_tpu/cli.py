@@ -1449,6 +1449,7 @@ def cmd_serve_bench(args) -> int:
     _bootstrap_devices(args)
     import concurrent.futures
     import json
+    import threading
     import time
 
     import numpy as np
@@ -1461,12 +1462,23 @@ def cmd_serve_bench(args) -> int:
         InferenceEngine,
         QueueFullError,
         RequestTimeoutError,
-        RetrievalIndex,
+        RetrievalRouter,
+        SwapController,
     )
     from distributed_sigmoid_loss_tpu.utils.logging import MetricsLogger
 
     if args.requests < 1 or args.clients < 1:
         print("--requests and --clients must be >= 1", file=sys.stderr)
+        return 2
+    if args.swap_every < 0 or args.rerank_k < 0:
+        print("--swap-every and --rerank-k must be >= 0", file=sys.stderr)
+        return 2
+    if args.index_tier == "sharded" and not args.mesh:
+        print(
+            "--index-tier sharded needs --mesh (the dp axis the corpus "
+            "partitions over; pair with --cpu-devices N off-chip)",
+            file=sys.stderr,
+        )
         return 2
     try:
         buckets = tuple(int(b) for b in args.batch_buckets.split(","))
@@ -1519,20 +1531,58 @@ def cmd_serve_bench(args) -> int:
 
     # Corpus embeddings straight through the engine (the service clock should
     # measure client traffic, not index build); chunked to the largest bucket.
-    index = RetrievalIndex()
     step = buckets[-1]
-    for i in range(0, min(args.index_size, pool), step):
-        index.add(engine.encode_image(pool_images[i : i + step]))
+    corpus_rows = [
+        engine.encode_image(pool_images[i : i + step])
+        for i in range(0, min(args.index_size, pool), step)
+    ]
+    corpus_emb = np.concatenate(corpus_rows)
+    router = RetrievalRouter(
+        tier=args.index_tier,
+        mesh=mesh if args.index_tier == "sharded" else None,
+        rerank_k=args.rerank_k or None,
+    )
+    router.publish(corpus_emb)
+    if args.index_tier == "sharded":
+        # Warm the fan-out program off the clock — same discipline as the
+        # engine's bucket warmup (the shard_map compiles once per query
+        # bucket; client searches are single-query).
+        router.search(corpus_emb[:1], k=args.topk)
 
     service = EmbeddingService(
         engine,
         cache=EmbeddingCache(args.cache_size),
-        index=index,
+        index=router,
         max_wait_ms=args.max_wait_ms,
         max_queue=args.max_queue,
         default_timeout=60.0,
         logger=MetricsLogger(),
     )
+
+    # --swap-every N churn: a swapper thread republishes the weights and
+    # freshly built index segments after every N completed client ops —
+    # the zero-downtime/zero-recompile contract exercised UNDER the same
+    # traffic the bench measures (swap_count / swap_latency_ms land in the
+    # record; the compile_count gate below still applies).
+    ops_done = [0]
+    swap_done = threading.Event()
+    swap_thread = None
+    if args.swap_every:
+        controller = SwapController(engine, router)
+
+        def swapper():
+            next_at = args.swap_every
+            while not swap_done.is_set():
+                if ops_done[0] >= next_at:
+                    controller.swap(params=params, embeddings=corpus_emb)
+                    next_at += args.swap_every
+                else:
+                    swap_done.wait(0.002)
+
+        swap_thread = threading.Thread(
+            target=swapper, name="serve-bench-swapper", daemon=True
+        )
+        swap_thread.start()
 
     def client(cid: int, n_ops: int) -> None:
         rng = np.random.default_rng(args.seed * 1000 + cid)
@@ -1553,12 +1603,16 @@ def cmd_serve_bench(args) -> int:
                     service.encode_text(row)
             except (QueueFullError, RequestTimeoutError):
                 pass  # shed/missed requests are counted in service.stats()
+            ops_done[0] += 1
 
     per_client = [args.requests // args.clients] * args.clients
     for i in range(args.requests % args.clients):
         per_client[i] += 1
     with concurrent.futures.ThreadPoolExecutor(args.clients) as pool_ex:
         list(pool_ex.map(client, range(args.clients), per_client))
+    if swap_thread is not None:
+        swap_done.set()
+        swap_thread.join(timeout=60)
 
     snap = service.stats()
     service.close()
@@ -1572,6 +1626,8 @@ def cmd_serve_bench(args) -> int:
         "batch_buckets": list(buckets),
         "max_wait_ms": args.max_wait_ms,
         "sharded": bool(mesh),
+        "index_tier": args.index_tier,
+        "swap_every": args.swap_every,
         "warmup_s": round(warmup_s, 2),
         **snap,
     }
@@ -2117,6 +2173,24 @@ def main(argv=None) -> int:
                          "(repeats exercise the cache)")
     sb.add_argument("--index-size", type=int, default=64,
                     help="corpus rows indexed for the search requests")
+    sb.add_argument("--index-tier", choices=["exact", "sharded", "ann"],
+                    default="exact",
+                    help="retrieval tier answering search requests: exact = "
+                         "single-host chunked scan (the oracle), sharded = "
+                         "dp-mesh per-shard top-k + merged candidates "
+                         "(requires --mesh), ann = int8 quantize-then-rerank "
+                         "with measured recall@k in the record "
+                         "(docs/SERVING.md)")
+    sb.add_argument("--swap-every", type=int, default=0, metavar="N",
+                    help="churn mode: hot-swap the weights + freshly built "
+                         "index segments after every N completed client ops "
+                         "(0 = off); swap_count / swap_latency_ms land in "
+                         "the record and the zero-recompile gate still "
+                         "applies")
+    sb.add_argument("--rerank-k", type=int, default=0, metavar="K",
+                    help="ann tier: coarse candidates kept for the exact "
+                         "re-rank (0 = auto: max(8·topk, 64)) — the "
+                         "recall/latency knob")
     sb.add_argument("--topk", type=int, default=5)
     sb.add_argument("--seed", type=int, default=0)
     sb.add_argument("--mesh", action="store_true",

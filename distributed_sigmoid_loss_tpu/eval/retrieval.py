@@ -22,7 +22,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
 
-__all__ = ["retrieval_ranks", "recall_at_k", "retrieval_metrics", "topk_ids"]
+__all__ = [
+    "retrieval_ranks",
+    "recall_at_k",
+    "retrieval_metrics",
+    "topk_ids",
+    "merge_topk",
+]
 
 
 def topk_ids(sims, k: int) -> np.ndarray:
@@ -39,6 +45,34 @@ def topk_ids(sims, k: int) -> np.ndarray:
     sims = np.asarray(sims)
     order = np.argsort(-sims, axis=-1, kind="stable")
     return order[..., :k]
+
+
+def merge_topk(scores, ids, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Merge per-row candidate ``(score, id)`` lists into the global top-k
+    under the :func:`topk_ids` contract: descending score, exact ties broken
+    toward the LOWER id.
+
+    ``scores``/``ids``: ``(..., C)`` candidate lists (any per-row order —
+    e.g. the concatenation of per-shard top-k lists from a sharded index).
+    When ids are insertion positions (the default everywhere in this repo),
+    "lower id" IS :func:`topk_ids`'s lower-index tie break, so a sharded
+    merge through here is ranking-identical to the one-matrix oracle.
+    Candidates with id < 0 are padding (masked to -inf) and never selected
+    while a real candidate remains.
+    """
+    scores = np.asarray(scores)
+    ids = np.asarray(ids, dtype=np.int64)
+    scores = np.where(ids < 0, -np.inf, scores)
+    # Order candidates by ascending id first; the STABLE score sort then
+    # resolves every exact tie to the lower id — the topk_ids tie contract.
+    by_id = np.argsort(ids, axis=-1, kind="stable")
+    s = np.take_along_axis(scores, by_id, axis=-1)
+    i = np.take_along_axis(ids, by_id, axis=-1)
+    order = np.argsort(-s, axis=-1, kind="stable")[..., :k]
+    return (
+        np.take_along_axis(s, order, axis=-1),
+        np.take_along_axis(i, order, axis=-1),
+    )
 
 
 def retrieval_ranks(zimg: jax.Array, ztxt: jax.Array) -> jax.Array:

@@ -61,6 +61,11 @@ SERVE_STATS_FIELDS = frozenset({
     "metric", "uptime_s", "requests", "items", "qps", "items_per_sec",
     "latency_ms", "batch_size_hist", "stage_latency_ms", "rejected",
     "timeouts", "compile_count", "bucket_space", "index_size", "cache",
+    # serve/distindex (RetrievalRouter.stats): retrieval tier, versioned
+    # hot-swap bookkeeping, measured ann recall, and the per-search-stage
+    # (fanout/merge/coarse/rerank/exact) latency percentiles.
+    "index_tier", "index_version", "shard_count", "swap_count",
+    "swap_latency_ms", "recall_at_k", "rerank_k", "search_stage_latency_ms",
 })
 
 # obs/health.py HealthEvent.record() — the structured watchdog events the

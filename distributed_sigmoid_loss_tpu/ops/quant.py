@@ -44,6 +44,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 __all__ = [
@@ -52,6 +53,8 @@ __all__ = [
     "int8_expert_matmul",
     "int8_expert_matmul_ste",
     "quantize_int8",
+    "sign_sketch",
+    "sign_sketch_scores",
 ]
 
 # Symmetric int8: round-to-nearest into [-127, 127] (−128 unused, keeping the
@@ -136,6 +139,40 @@ def int8_dot_general(lhs, rhs, dimension_numbers, precision=None,
     n_rhs_free = rhs.ndim - 1
     ls_b = ls_free.reshape(ls_free.shape + (1,) * n_rhs_free)
     return (acc.astype(jnp.float32) * ls_b * rs_free).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Binary sign sketches — the 1-bit coarse gear of the serving ANN tier.
+#
+# "Dissecting Embedding Bag Performance in DLRM Inference" (PAPERS.md): this
+# workload is memory-bandwidth-bound, so the candidate-pruning scan's cost is
+# the bytes it streams. int8 rows are 4x smaller than f32; sign bits are 32x.
+# For L2-normalized embeddings, sign-agreement count (d - 2*hamming) is a
+# monotone proxy for the dot product — good enough to PRUNE, never to RANK
+# (serve/ann.py re-ranks the survivors exactly). Host-side numpy on purpose:
+# the coarse scan runs where the index lives, outside any traced code.
+# ---------------------------------------------------------------------------
+
+_POPCOUNT = np.array([bin(v).count("1") for v in range(256)], dtype=np.uint8)
+
+
+def sign_sketch(x) -> np.ndarray:
+    """(n, d) float rows → (n, ceil(d/8)) packed sign bits (bit = row >= 0)."""
+    x = np.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"sign_sketch expects (n, d) rows, got {x.shape}")
+    return np.packbits(x >= 0.0, axis=1)
+
+
+def sign_sketch_scores(qbits: np.ndarray, cbits: np.ndarray, dim: int) -> np.ndarray:
+    """Coarse scores (q, n) between packed query/corpus sketches: the
+    sign-agreement count ``d - 2*hamming`` (∝ the dot of the sign vectors).
+    ``dim`` is the unpacked embedding dim (pad bits beyond it cancel out of
+    the ORDERING per query row, so they are left in the count)."""
+    # XOR per (query, corpus-row) byte panel, popcount via table lookup.
+    xor = np.bitwise_xor(qbits[:, None, :], cbits[None, :, :])  # (q, n, B)
+    hamming = _POPCOUNT[xor].sum(axis=-1, dtype=np.int32)
+    return (dim - 2 * hamming).astype(np.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -13,14 +13,25 @@ system (the ROADMAP's "heavy traffic" north star), runnable on CPU in tests:
   hit/miss/eviction counters.
 - :mod:`.index` — exact chunked dot-product top-k over L2-normalized rows,
   ranking-identical to ``eval.retrieval`` (shared tie-break contract).
+- :mod:`.shard_index` — the same exact top-k partitioned over the dp mesh:
+  per-shard candidates in a ``shard_map`` region, host-merged under the
+  shared tie contract (ranking-identical to the one-matrix oracle).
+- :mod:`.ann` — the approximate tier: int8 / sign-sketch coarse pruning
+  (reusing ``ops.quant``) then exact re-rank, with measured recall@k.
+- :mod:`.swap` — zero-downtime hot swap of weights + index segments
+  (versioned, double-buffered, zero recompiles).
 - :mod:`.service` — the façade: ``encode_text`` / ``encode_image`` /
   ``search`` with per-request timeouts and a ``stats()`` snapshot (qps,
-  latency percentiles, batch histogram, cache hit rate, compile count).
+  latency percentiles, batch histogram, cache hit rate, compile count) —
+  plus ``RetrievalRouter``, the tiered/versioned index front end.
 
 Entry point: ``python -m distributed_sigmoid_loss_tpu serve-bench`` drives the
-whole stack on synthetic data and prints the stats snapshot as JSON.
+whole stack on synthetic data and prints the stats snapshot as JSON
+(``--index-tier`` picks the retrieval tier, ``--swap-every`` adds hot-swap
+churn).
 """
 
+from distributed_sigmoid_loss_tpu.serve.ann import AnnIndex  # noqa: F401
 from distributed_sigmoid_loss_tpu.serve.batcher import (  # noqa: F401
     BatcherClosedError,
     MicroBatcher,
@@ -35,9 +46,15 @@ from distributed_sigmoid_loss_tpu.serve.index import RetrievalIndex  # noqa: F40
 from distributed_sigmoid_loss_tpu.serve.service import (  # noqa: F401
     EmbeddingService,
     RequestTimeoutError,
+    RetrievalRouter,
 )
+from distributed_sigmoid_loss_tpu.serve.shard_index import (  # noqa: F401
+    ShardedIndex,
+)
+from distributed_sigmoid_loss_tpu.serve.swap import SwapController  # noqa: F401
 
 __all__ = [
+    "AnnIndex",
     "BatcherClosedError",
     "EmbeddingCache",
     "EmbeddingService",
@@ -46,5 +63,8 @@ __all__ = [
     "QueueFullError",
     "RequestTimeoutError",
     "RetrievalIndex",
+    "RetrievalRouter",
+    "ShardedIndex",
+    "SwapController",
     "content_key",
 ]

@@ -110,6 +110,41 @@ class InferenceEngine:
 
         return cls(img_fn, txt_fn, params, **kw)
 
+    # -- live refresh --------------------------------------------------------
+
+    def swap_params(self, new_params) -> None:
+        """Replace the parameter pytree WITHOUT recompiling anything.
+
+        The jitted encoders take params as an ARGUMENT, so a new tree with
+        the same treedef and leaf shapes/dtypes hits every warmed bucket's
+        compiled program — ``compile_count`` stays exactly where warmup left
+        it (the zero-downtime hot-swap contract, asserted by the swap tests).
+        A mismatched tree would silently change the programs' signatures and
+        trigger fresh compiles mid-traffic, so it is refused here instead.
+
+        Publication is atomic (one attribute assignment); an engine call
+        already in flight keeps the params it read at call start — requests
+        finish on the version they started on.
+        """
+        old_leaves, old_tree = jax.tree.flatten(self.params)
+        new_leaves, new_tree = jax.tree.flatten(new_params)
+        if old_tree != new_tree:
+            raise ValueError(
+                "swap_params: new param tree structure differs from the "
+                "serving tree — a structural change is a new engine, not a "
+                "hot swap"
+            )
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            o_spec = (tuple(getattr(o, "shape", ())), str(getattr(o, "dtype", "")))
+            n_spec = (tuple(getattr(n, "shape", ())), str(getattr(n, "dtype", "")))
+            if o_spec != n_spec:
+                raise ValueError(
+                    f"swap_params: leaf {i} spec {n_spec} != serving spec "
+                    f"{o_spec} — shape/dtype changes would recompile every "
+                    "bucket mid-traffic"
+                )
+        self.params = new_params
+
     # -- introspection -------------------------------------------------------
 
     @property

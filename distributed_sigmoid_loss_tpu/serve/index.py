@@ -33,7 +33,9 @@ class RetrievalIndex:
     order); ``search`` returns ``(scores, ids)`` of the top-k by dot product,
     descending, ties broken by insertion order (earlier row wins). Thread-safe
     for concurrent add/search (snapshot semantics: a search sees the rows
-    present when it started).
+    present when it started — an ``add`` landing MID-scan is invisible to
+    that search, never a torn chunk; pinned by the gated-interleaving test in
+    tests/test_serve.py).
     """
 
     def __init__(self, *, chunk_size: int = 4096, dtype=np.float32):

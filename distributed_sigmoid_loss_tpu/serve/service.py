@@ -20,21 +20,264 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import TimeoutError as FutureTimeoutError
+from dataclasses import dataclass
 from typing import Callable, Sequence
 
 import numpy as np
 
+from distributed_sigmoid_loss_tpu.eval.retrieval import merge_topk
+from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
+from distributed_sigmoid_loss_tpu.serve.ann import AnnIndex
 from distributed_sigmoid_loss_tpu.serve.batcher import MicroBatcher, QueueFullError
 from distributed_sigmoid_loss_tpu.serve.cache import EmbeddingCache, content_key
 from distributed_sigmoid_loss_tpu.serve.engine import InferenceEngine
 from distributed_sigmoid_loss_tpu.serve.index import RetrievalIndex
+from distributed_sigmoid_loss_tpu.serve.shard_index import ShardedIndex
 from distributed_sigmoid_loss_tpu.utils.logging import LatencyWindow, MetricsLogger
 
-__all__ = ["EmbeddingService", "RequestTimeoutError"]
+__all__ = ["EmbeddingService", "RequestTimeoutError", "RetrievalRouter"]
 
 
 class RequestTimeoutError(TimeoutError):
     """The request's deadline passed before its batch finished encoding."""
+
+
+@dataclass(frozen=True)
+class _IndexVersion:
+    """One immutable published generation of index segments. A search reads
+    the CURRENT version once and keeps it for its whole lifetime — a swap
+    mid-search can never hand it a torn mix of old and new segments."""
+
+    version: int
+    exact: RetrievalIndex
+    sharded: ShardedIndex | None
+    ann: AnnIndex | None
+    size: int
+
+
+class RetrievalRouter:
+    """Versioned, tiered retrieval front end: ``exact`` / ``sharded`` / ``ann``.
+
+    Drop-in for ``EmbeddingService``'s ``index=`` slot (same ``search`` /
+    ``__len__`` surface) with three additions the plain index cannot offer:
+
+    - **tier routing** — ``exact`` is the single-host chunked oracle scan,
+      ``sharded`` fans per-shard top-k over the dp mesh and merges the
+      gathered candidates (``serve/shard_index.py``), ``ann`` prunes with
+      quantized coarse scores then re-ranks exactly (``serve/ann.py``);
+    - **versioned publication** — ``publish`` builds fresh index segments
+      double-buffered (the old version keeps serving during the build) and
+      swaps one reference atomically; every response can report the version
+      it was served from (``return_version=True``), which is monotonically
+      non-decreasing across a client's requests;
+    - **measured recall** — on the ann tier every ``measure_every``-th
+      search is ALSO answered by the exact oracle and the id overlap feeds
+      the running ``recall_at_k`` in :meth:`stats` (exact/sharded report
+      1.0 by construction — they are ranking-identical to the oracle).
+
+    Per-stage latencies (fan-out / merge / coarse / re-rank / exact scan)
+    land in :meth:`stats` and, when ``spans`` is wired, on the graftscope
+    host timeline as ``serve/search/<stage>`` spans.
+    """
+
+    TIERS = ("exact", "sharded", "ann")
+    STAGES = ("exact", "fanout", "merge", "coarse", "rerank")
+
+    def __init__(
+        self,
+        *,
+        tier: str = "exact",
+        mesh=None,
+        axis_name: str = data_axis,
+        coarse: str = "int8",
+        rerank_k: int | None = None,
+        measure_every: int = 16,
+        chunk_size: int = 4096,
+        query_buckets=(1, 8, 64),
+        spans=None,
+    ):
+        if tier not in self.TIERS:
+            raise ValueError(f"tier must be one of {self.TIERS}, got {tier!r}")
+        if tier == "sharded" and mesh is None:
+            raise ValueError(
+                "tier='sharded' needs a mesh= (the dp axis the corpus "
+                "partitions over); pass parallel.mesh.make_mesh()"
+            )
+        self.tier = tier
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.coarse = coarse
+        self.rerank_k = rerank_k if rerank_k else None
+        self.measure_every = max(int(measure_every), 0)
+        self.chunk_size = chunk_size
+        self.query_buckets = tuple(query_buckets)
+        self.spans = spans
+        self._current: _IndexVersion | None = None
+        self._publish_lock = threading.Lock()
+        self._versions = 0
+        self._stats_lock = threading.Lock()
+        self._swap_count = 0
+        self._swap_window = LatencyWindow(1024)
+        self._stage_windows = {s: LatencyWindow(4096) for s in self.STAGES}
+        self._searches = 0
+        self._recall_sum = 0.0
+        self._recall_n = 0
+        self._last_rerank_k = 0
+
+    # -- publication ---------------------------------------------------------
+
+    def build(self, embeddings, ids=None) -> dict:
+        """Build fresh index segments for a corpus WITHOUT publishing them —
+        the double-buffer half: runs outside any lock while the current
+        version keeps serving. Feed the result to :meth:`publish_built`."""
+        emb = np.ascontiguousarray(embeddings, dtype=np.float32)
+        exact = RetrievalIndex(chunk_size=self.chunk_size)
+        exact.add(emb, ids)
+        sharded = ann = None
+        if self.tier == "sharded":
+            sharded = ShardedIndex(
+                emb, ids, mesh=self.mesh, axis_name=self.axis_name,
+                query_buckets=self.query_buckets,
+            )
+        elif self.tier == "ann":
+            ann = AnnIndex(emb, ids, coarse=self.coarse, rerank_k=self.rerank_k)
+        return {"exact": exact, "sharded": sharded, "ann": ann, "size": len(emb)}
+
+    def publish_built(self, built: dict | None) -> int:
+        """Atomically publish segments from :meth:`build` (None re-publishes
+        the current segments under a new version — a params-only swap).
+        Returns the new version number; in-flight searches finish on the
+        version they started with."""
+        with self._publish_lock:
+            if built is None:
+                cur = self._current
+                if cur is None:
+                    raise ValueError("publish_built(None) before any publish()")
+                built = {
+                    "exact": cur.exact, "sharded": cur.sharded,
+                    "ann": cur.ann, "size": cur.size,
+                }
+            self._versions += 1
+            self._current = _IndexVersion(version=self._versions, **built)
+            return self._versions
+
+    def publish(self, embeddings, ids=None) -> int:
+        """Build + atomically publish a new corpus; returns the version."""
+        return self.publish_built(self.build(embeddings, ids))
+
+    @property
+    def version(self) -> int:
+        v = self._current
+        return v.version if v is not None else 0
+
+    def record_swap(self, seconds: float) -> None:
+        """Swap bookkeeping (called by ``serve.swap.SwapController``)."""
+        with self._stats_lock:
+            self._swap_count += 1
+        self._swap_window.record(seconds)
+
+    # -- search --------------------------------------------------------------
+
+    def _stage(self, stage: str, t0: float, t1: float) -> None:
+        self._stage_windows[stage].record(t1 - t0)
+        if self.spans is not None:
+            self.spans.record(f"serve/search/{stage}", t0, t1)
+
+    def search(self, queries, k: int = 10, *, return_version: bool = False):
+        """Top-k under the shared ranking contract, routed by tier. Returns
+        ``(scores, ids)`` — or ``(scores, ids, version)`` with
+        ``return_version=True``, where version is the index generation this
+        answer was computed from."""
+        v = self._current
+        if v is None:
+            raise ValueError("search() before the first publish()")
+        arr = np.asarray(queries)
+        squeeze = arr.ndim == 1
+        k = min(int(k), v.size)
+        if self.tier == "exact":
+            t0 = time.monotonic()
+            scores, ids = v.exact.search(arr, k)
+            self._stage("exact", t0, time.monotonic())
+        elif self.tier == "sharded":
+            t0 = time.monotonic()
+            cand_s, cand_i = v.sharded.candidates(arr, k)
+            t1 = time.monotonic()
+            self._stage("fanout", t0, t1)
+            scores, ids = merge_topk(cand_s, cand_i, k)
+            if squeeze:
+                scores, ids = scores[0], ids[0]
+            self._stage("merge", t1, time.monotonic())
+        else:  # ann
+            rk = v.ann._resolve_rerank_k(k, None)
+            t0 = time.monotonic()
+            pos = v.ann.coarse_positions(arr, rk)
+            t1 = time.monotonic()
+            self._stage("coarse", t0, t1)
+            scores, ids = v.ann.rerank(arr, pos, k)
+            if squeeze:
+                scores, ids = scores[0], ids[0]
+            self._stage("rerank", t1, time.monotonic())
+            self._measure_recall(v, arr, k, ids, rk)
+        with self._stats_lock:
+            self._searches += 1
+        if return_version:
+            return scores, ids, v.version
+        return scores, ids
+
+    def _measure_recall(self, v, queries, k, ann_ids, rk) -> None:
+        """Every measure_every-th ann search is also answered exactly; the
+        id overlap feeds the running recall@k stat."""
+        with self._stats_lock:
+            self._last_rerank_k = rk
+            due = self.measure_every and self._searches % self.measure_every == 0
+        if not due:
+            return
+        _, exact_ids = v.exact.search(queries, k)
+        ann2 = np.atleast_2d(np.asarray(ann_ids))
+        exact2 = np.atleast_2d(exact_ids)
+        hits = [
+            len(set(a.tolist()) & set(e.tolist())) / max(len(e), 1)
+            for a, e in zip(ann2, exact2)
+        ]
+        with self._stats_lock:
+            self._recall_sum += float(np.mean(hits))
+            self._recall_n += 1
+
+    def __len__(self) -> int:
+        v = self._current
+        return v.size if v is not None else 0
+
+    # -- ops surface ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The router's registered stats fields (obs/metrics_schema.py SERVE
+        registry) — merged into ``EmbeddingService.stats()``'s snapshot."""
+        with self._stats_lock:
+            swap_count = self._swap_count
+            recall = (
+                round(self._recall_sum / self._recall_n, 4)
+                if self._recall_n
+                else (1.0 if self.tier != "ann" else None)
+            )
+            rerank_k = self.rerank_k or self._last_rerank_k
+        v = self._current
+        snap = {
+            "index_tier": self.tier,
+            "index_version": v.version if v is not None else 0,
+            "shard_count": v.sharded.shard_count
+            if v is not None and v.sharded is not None
+            else 1,
+            "swap_count": swap_count,
+            "swap_latency_ms": self._swap_window.percentiles_ms((50, 95, 99)),
+            "recall_at_k": recall,
+            "rerank_k": rerank_k,
+            "search_stage_latency_ms": {
+                s: w.percentiles_ms((50, 95, 99))
+                for s, w in self._stage_windows.items()
+                if w.count
+            },
+        }
+        return snap
 
 
 class EmbeddingService:
@@ -44,7 +287,10 @@ class EmbeddingService:
     requests (the CLI's byte/BPE tokenizers fit the signature); pre-tokenized
     rows and pixel arrays always work. ``cache=None`` disables caching,
     ``index`` defaults to an empty :class:`RetrievalIndex` that ``search``
-    queries after you ``add`` corpus embeddings to it.
+    queries after you ``add`` corpus embeddings to it — or pass a
+    :class:`RetrievalRouter` for tiered (sharded/ann) and hot-swappable
+    retrieval; its registered stats fields then ride the :meth:`stats`
+    snapshot.
     """
 
     def __init__(
@@ -198,17 +444,26 @@ class EmbeddingService:
         return self._encode("image", list(arr), timeout)
 
     def search(
-        self, queries, k: int = 10, *, timeout: float | None = None
+        self,
+        queries,
+        k: int = 10,
+        *,
+        timeout: float | None = None,
+        return_version: bool = False,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Top-k over the index. Queries: strings / int token rows (encoded
         through the text tower) or float rows (used as embeddings directly).
         Returns ``(scores, ids)`` — ordering contract of ``RetrievalIndex``.
+        ``return_version=True`` (a :class:`RetrievalRouter` index only)
+        additionally returns the index version that served the answer.
         """
         arr = queries if isinstance(queries, np.ndarray) else None
         if arr is not None and np.issubdtype(arr.dtype, np.floating):
             emb = arr  # already embeddings
         else:
             emb = self.encode_text(queries, timeout=timeout)
+        if return_version:
+            return self.index.search(emb, k, return_version=True)
         return self.index.search(emb, k)
 
     # -- ops surface ---------------------------------------------------------
@@ -245,6 +500,11 @@ class EmbeddingService:
         }
         if self.cache is not None:
             snap["cache"] = self.cache.stats()
+        if isinstance(self.index, RetrievalRouter):
+            # Tier/version/swap/recall fields — the router emits only keys
+            # registered in the SERVE schema, so the merged snapshot stays
+            # schema-valid end to end.
+            snap.update(self.index.stats())
         return snap
 
     def log_stats(self) -> dict:

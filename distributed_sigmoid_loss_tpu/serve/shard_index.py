@@ -1,0 +1,207 @@
+"""dp-mesh-sharded retrieval index: per-shard exact top-k, merged candidates.
+
+``serve.index.RetrievalIndex`` is a single-host O(corpus) scan per query —
+correct, but the whole corpus streams through one host's memory bus on every
+search, and "Dissecting Embedding Bag Performance in DLRM Inference"
+(PAPERS.md) says that bus IS the bottleneck for this workload. Sharding is
+the first lever: partition the corpus rows over the mesh's ``dp`` axis so
+each device scans 1/W of the rows (1/W the bytes, W-way parallel), compute
+the per-shard exact top-k inside a ``shard_map`` region, and merge the
+gathered ``(score, id)`` candidate lists on the host.
+
+The merge is ranking-identical to the one-matrix oracle
+(:func:`eval.retrieval.topk_ids`) including tie order, by construction:
+
+- rows are partitioned CONTIGUOUSLY (shard w holds insertion positions
+  ``[w*n_per, (w+1)*n_per)``), so within a shard ascending local index is
+  ascending global id;
+- ``lax.top_k`` is stable (ties keep the lower index) — a shard's own top-k
+  list already prefers the lower id, so truncating to k per shard can never
+  drop a candidate the global merge would have picked;
+- the host merge (:func:`eval.retrieval.merge_topk`) resolves cross-shard
+  ties toward the lower id — exactly ``topk_ids``'s lower-index tie break
+  when ids are insertion positions (the default).
+
+Snapshot semantics are IMMUTABLE: an instance is built once from a corpus
+array and never mutated. Live refresh is a new instance published atomically
+by ``serve.swap.SwapController`` / ``RetrievalRouter`` — in-flight searches
+keep the segments they started with (the double-buffer contract), and there
+is no lock on the search path at all.
+
+Compile discipline mirrors the engine's: queries are padded up to a fixed
+``query_buckets`` grid and the shard_map program is compiled once per
+(query bucket, k_local) point — steady-state search traffic never triggers a
+fresh XLA compile (``compile_count`` introspection included).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_sigmoid_loss_tpu.eval.retrieval import merge_topk
+from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
+
+__all__ = ["ShardedIndex"]
+
+
+def _shard_topk(q, rows, ids, *, k_local: int):
+    """Per-shard exact top-k; runs inside the shard_map region.
+
+    ``q`` (qb, d) replicated; ``rows`` (n_per, d) / ``ids`` (n_per,) this
+    shard's contiguous corpus slice (id -1 = padding). Returns
+    ``(scores, ids)`` shaped (1, qb, k_local) so the ``P(axis)`` out_spec
+    concatenates the per-shard candidate lists on the leading axis — the
+    gathered lists the host merge consumes.
+    """
+    sims = q @ rows.T  # (qb, n_per)
+    sims = jnp.where(ids[None, :] >= 0, sims, -jnp.inf)
+    scores, idx = lax.top_k(sims, k_local)  # stable: ties keep the lower index
+    return scores[None], ids[idx][None]
+
+
+@lru_cache(maxsize=32)
+def _shard_topk_fn(mesh: Mesh, axis_name: str, k_local: int):
+    """One compiled fan-out program per (mesh, axis, k_local); jit adds the
+    per-query-bucket specialization. Bounded LRU like eval/retrieval's."""
+    return jax.jit(
+        jax.shard_map(
+            partial(_shard_topk, k_local=k_local),
+            mesh=mesh,
+            in_specs=(P(None), P(axis_name), P(axis_name)),
+            out_specs=(P(axis_name), P(axis_name)),
+        )
+    )
+
+
+class ShardedIndex:
+    """Immutable dp-sharded exact top-k index over embedding rows.
+
+    ``search`` returns ``(scores (q, k), ids (q, k))``, score-descending,
+    exact ties broken toward the LOWER id — with default ids (insertion
+    positions) this is bit-for-bit the ``eval.retrieval.topk_ids`` ranking.
+    ``candidates`` exposes the raw gathered per-shard lists so callers (the
+    ``RetrievalRouter``) can time fan-out and merge as separate stages.
+    """
+
+    def __init__(
+        self,
+        embeddings,
+        ids=None,
+        *,
+        mesh: Mesh,
+        axis_name: str = data_axis,
+        query_buckets=(1, 8, 64),
+        dtype=np.float32,
+    ):
+        rows = np.ascontiguousarray(embeddings, dtype=dtype)
+        if rows.ndim != 2 or not len(rows):
+            raise ValueError(
+                f"embeddings must be a non-empty (n, d) array, got {rows.shape}"
+            )
+        if ids is None:
+            ids = np.arange(len(rows), dtype=np.int64)
+        else:
+            ids = np.asarray(ids, dtype=np.int64)
+            if ids.shape != (len(rows),):
+                raise ValueError(f"ids shape {ids.shape} != ({len(rows)},)")
+            if (ids < 0).any():
+                raise ValueError("ids must be >= 0 (negative marks padding)")
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.query_buckets = tuple(sorted(set(int(b) for b in query_buckets)))
+        if not self.query_buckets or self.query_buckets[0] < 1:
+            raise ValueError(f"bad query_buckets {query_buckets!r}")
+        self.size = len(rows)
+        self.dim = rows.shape[1]
+        self.shard_count = int(mesh.shape[axis_name])
+        # Contiguous partition, padded so every shard holds n_per rows; pad
+        # rows are zeros with id -1 (masked to -inf inside the region).
+        self.rows_per_shard = -(-self.size // self.shard_count)
+        n_pad = self.shard_count * self.rows_per_shard
+        if n_pad != self.size:
+            rows = np.concatenate(
+                [rows, np.zeros((n_pad - self.size, self.dim), dtype=rows.dtype)]
+            )
+            ids = np.concatenate(
+                [ids, np.full(n_pad - self.size, -1, dtype=np.int64)]
+            )
+        sharding = NamedSharding(mesh, P(axis_name))
+        # int32 on device: x64 is disabled repo-wide; sizes < 2**31 by far.
+        self._rows = jax.device_put(rows, sharding)
+        self._ids = jax.device_put(ids.astype(np.int32), sharding)
+        self._compiled: set[tuple[int, int]] = set()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self.size
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct (query bucket, k_local) fan-out programs run so far —
+        the engine's compile-discipline introspection, for the index."""
+        with self._lock:
+            return len(self._compiled)
+
+    def _query_bucket(self, n: int) -> int:
+        for b in self.query_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"query batch {n} exceeds the largest query bucket "
+            f"{self.query_buckets[-1]}; split the request or extend "
+            "query_buckets"
+        )
+
+    def candidates(self, queries, k: int) -> tuple[np.ndarray, np.ndarray]:
+        """Gathered per-shard candidate lists: ``(scores, ids)`` each
+        (q, W * k_local) — the fan-out stage. ``merge_topk`` of these is the
+        global top-k; :meth:`search` does exactly that."""
+        q = np.ascontiguousarray(queries, dtype=np.float32)
+        if q.ndim == 1:
+            q = q[None]
+        if q.shape[1] != self.dim:
+            raise ValueError(f"query dim {q.shape[1]} != index dim {self.dim}")
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        k = min(k, self.size)
+        k_local = min(k, self.rows_per_shard)
+        qb = self._query_bucket(len(q))
+        padded = np.zeros((qb, self.dim), dtype=np.float32)
+        padded[: len(q)] = q
+        with self._lock:
+            self._compiled.add((qb, k_local))
+        fn = _shard_topk_fn(self.mesh, self.axis_name, k_local)
+        s, i = fn(padded, self._rows, self._ids)  # (W, qb, k_local) each
+        s = np.asarray(s)[:, : len(q)]
+        i = np.asarray(i)[:, : len(q)]
+        # (W, q, k_local) -> (q, W * k_local) gathered candidate lists.
+        cand_s = np.moveaxis(s, 0, 1).reshape(len(q), -1)
+        cand_i = np.moveaxis(i, 0, 1).reshape(len(q), -1).astype(np.int64)
+        return cand_s, cand_i
+
+    def search(self, queries, k: int) -> tuple[np.ndarray, np.ndarray]:
+        """(q, d) or (d,) queries → top-k ``(scores, ids)`` under the shared
+        ranking contract. k clamps to the corpus size."""
+        squeeze = np.asarray(queries).ndim == 1
+        cand_s, cand_i = self.candidates(queries, k)
+        k = min(int(k), self.size)
+        scores, ids = merge_topk(cand_s, cand_i, k)
+        if squeeze:
+            return scores[0], ids[0]
+        return scores, ids
+
+    def stats(self) -> dict:
+        return {
+            "size": self.size,
+            "shard_count": self.shard_count,
+            "rows_per_shard": self.rows_per_shard,
+            "compile_count": self.compile_count,
+        }

@@ -1,0 +1,75 @@
+#!/bin/bash
+# Round-11 chip measurement queue — price the serving retrieval tiers
+# (serve/distindex: exact vs sharded vs ann at matched corpus sizes) and
+# soak the zero-recompile hot-swap churn path under live traffic:
+#   nohup bash docs/round11_chip_queue.sh > /tmp/r11queue.log 2>&1 &
+#
+# PERF-STREAM DEBT NOTE (carry-forward): BENCH_r04 and BENCH_r05 recorded
+# 0.0 (backend unavailable both rounds); the last driver-verified headline
+# is round 3's 761.74 pairs/s/chip (vs_baseline 0.692). The round-10 pallas
+# and _32k_equiv recipes are still queued — landing real numbers for them
+# AND for the serve tiers below is part of this round, not an afterthought.
+#
+# Same recovery-waiting discipline as rounds 5-10: one bounded probe per
+# cycle until the tunnel answers, then measurements cheapest-first. NEVER
+# signal a running bench process (SIGTERM mid-XLA-compile wedges the tunnel
+# — docs/PERF.md postmortems); --serve-bench is a fresh-compile config
+# (engine bucket warmup) and rides the detached compile shield
+# automatically. serve_bench records are schema-validated and exit non-zero
+# if any request escapes the warmed bucket grid — a rc!=0 line below is a
+# finding, not noise.
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-10 queue.
+while pgrep -f round10_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+set -x
+# 0. Headline anchor first (cached compiles) — the perf stream needs ANY
+#    driver-verified train number this round (see the debt note above).
+python bench.py
+# 1. Serving tier A/B at matched corpus size: exact vs ann on one chip
+#    (the sharded tier needs a multi-chip mesh — recipe 4). 512 requests,
+#    8 clients, 256-row corpus; compare value (req/s), latency_ms p99 and
+#    search_stage_latency_ms across the records. recall_at_k rides the ann
+#    record — read it BEFORE reading the speed number.
+python bench.py 64 8 tiny --serve-bench
+python bench.py 64 8 tiny --serve-bench --index-tier ann
+# 2. The b16 serving shape (real towers, the production encode cost):
+#    exact vs ann — the tier delta only matters if search time is visible
+#    next to encode time at the real model.
+python bench.py 64 8 b16 --serve-bench
+python bench.py 64 8 b16 --serve-bench --index-tier ann
+# 3. Hot-swap churn soak: a swap every 64 client ops across the whole run —
+#    zero-recompile gate enforced by the runner's exit code; swap_count and
+#    swap_latency_ms percentiles land in the record next to the qps they
+#    cost. A/B against the no-churn run in recipe 1.
+python bench.py 64 8 tiny --serve-bench --swap-every 64
+python bench.py 64 8 b16 --serve-bench --swap-every 64
+# 4. Sharded tier on the pod slice (skips down to exact on 1 chip): the
+#    per-shard scan + merged-candidates path on real ICI.
+python bench.py 64 8 b16 --serve-bench --index-tier sharded
+# 5. Round-10 carry-forward: the still-unverified pallas headline and the
+#    driver-verified _32k_equiv recipes (see docs/round10_chip_queue.sh for
+#    the full ladder; these two are the headline debt).
+python bench.py 2048 10 b16 --use-pallas --metric-suffix _pallas
+python bench.py 4096 5 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --loss-impl chunked \
+  --use-pallas --metric-suffix _32k_equiv

@@ -53,6 +53,7 @@ _STANDARD_MODULES = {
     "test_contrastive",
     "test_core_loss",
     "test_data_pipeline",
+    "test_distindex",
     "test_distributed_parity",
     "test_obs",
     "test_pipeline",

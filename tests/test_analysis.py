@@ -451,6 +451,18 @@ def test_unregistered_metric_field_trips_metrics_schema_rule():
         sources={"serve/service.py": 'snap = {"qps": 1, "bogus_stat": 2}\n'}
     )
     assert [f.subject for f in bad_serve] == ["serve/service.py::bogus_stat"]
+    # the distindex router-stats record type: its registered fields stay
+    # green, and an UNregistered swap/tier field trips the rule — the drift
+    # guard for the serve/distindex record shape.
+    bad_router = repo_lint.check_metrics_schema(
+        sources={"serve/service.py":
+                 'snap = {"index_tier": "ann", "index_version": 3,\n'
+                 '        "swap_count": 1, "swap_latency_ms": {},\n'
+                 '        "recall_at_k": 0.99, "rerank_k": 64,\n'
+                 '        "search_stage_latency_ms": {},\n'
+                 '        "swap_epoch": 2}\n'}
+    )
+    assert [f.subject for f in bad_router] == ["serve/service.py::swap_epoch"]
     # health events: the dict a function named `record` returns
     bad_health = repo_lint.check_metrics_schema(
         sources={"obs/health.py":

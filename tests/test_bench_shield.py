@@ -122,6 +122,9 @@ def _bench_args(**overrides):
         # round-8 data-bench mode: jits the augment/commit programs (not in
         # the headline warm cache), so it shields.
         data_bench=False,
+        # round-11 serve-bench mode: warms one engine program per shape
+        # bucket (+ the sharded fan-out program) — fresh compiles, shielded.
+        serve_bench=False,
     )
     defaults.update(overrides)
     return argparse.Namespace(**defaults)

@@ -1,0 +1,477 @@
+"""serve/distindex: sharded top-k parity, ANN recall, zero-recompile hot swap.
+
+The production retrieval tier's contracts, in dependency order:
+
+- ``eval.retrieval.merge_topk``: the shared candidate-merge helper is
+  ranking-identical to ``topk_ids`` (ids AND tie order) for any split of a
+  score matrix into candidate lists.
+- ``ShardedIndex``: per-shard exact top-k over the 8-virtual-device CPU mesh,
+  merged candidates IDENTICAL to the one-matrix oracle — random fixtures
+  (margins), duplicated-row fixtures (exact tie order), uneven corpus sizes
+  (pad rows), k > rows-per-shard, and the query-bucket compile discipline.
+- ``AnnIndex``: int8 quantize-then-rerank recall@k >= 0.95 at defaults on the
+  test corpus (the acceptance floor); survivor ordering exactly the exact
+  path's; the sign-sketch coarse gear prunes at its wider rerank_k.
+- ``RetrievalRouter`` + ``SwapController`` + ``EmbeddingService``: tier
+  routing, stats schema, and the swap-under-load drill — concurrent client
+  threads across >= 3 hot swaps with zero request errors, monotonically
+  non-decreasing observed versions, and ``compile_count`` pinned flat.
+
+Everything runs on the 8-virtual-CPU-device conftest mesh; the only tower
+compiles are the module-scoped tiny engine fixture's four bucket programs.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.eval.retrieval import merge_topk, topk_ids
+from distributed_sigmoid_loss_tpu.serve import (
+    AnnIndex,
+    EmbeddingService,
+    InferenceEngine,
+    RetrievalRouter,
+    ShardedIndex,
+    SwapController,
+)
+
+
+def _l2(x):
+    return x / np.linalg.norm(x, axis=-1, keepdims=True)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from distributed_sigmoid_loss_tpu.parallel.mesh import make_mesh
+
+    return make_mesh()
+
+
+# ---------------------------------------------------------------------------
+# merge_topk — the shared candidate-merge contract
+# ---------------------------------------------------------------------------
+
+
+def test_merge_topk_matches_topk_ids_for_any_candidate_split():
+    rng = np.random.default_rng(0)
+    sims = rng.standard_normal((6, 40)).astype(np.float32)
+    sims[:, 7] = sims[:, 21]  # exact cross-candidate-list ties
+    want = topk_ids(sims, 9)
+    ids = np.broadcast_to(np.arange(40), sims.shape)
+    # Any per-row permutation of the candidate list must merge identically.
+    perm = rng.permutation(40)
+    _, got = merge_topk(sims[:, perm], ids[:, perm], 9)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_merge_topk_never_selects_padding_while_real_candidates_remain():
+    scores = np.array([[0.5, -np.inf, 0.9, 0.1]], np.float32)
+    ids = np.array([[3, -1, 0, 7]])
+    s, i = merge_topk(scores, ids, 3)
+    np.testing.assert_array_equal(i, [[0, 3, 7]])
+    assert np.isfinite(s).all()
+
+
+# ---------------------------------------------------------------------------
+# ShardedIndex vs the one-matrix oracle — ids AND tie order
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_topk_matches_oracle_uneven_corpus(mesh):
+    rng = np.random.default_rng(1)
+    corpus = _l2(rng.standard_normal((203, 16)).astype(np.float32))  # 203 = 8*25+3
+    queries = _l2(rng.standard_normal((9, 16)).astype(np.float32))
+    want = topk_ids(queries @ corpus.T, 7)
+    idx = ShardedIndex(corpus, mesh=mesh)
+    assert idx.shard_count == mesh.shape["dp"] and len(idx) == 203
+    scores, ids = idx.search(queries, 7)
+    np.testing.assert_array_equal(ids, want)
+    np.testing.assert_allclose(
+        scores, np.take_along_axis(queries @ corpus.T, want, axis=1), rtol=1e-5
+    )
+
+
+def test_sharded_topk_exact_tie_order_matches_oracle(mesh):
+    rng = np.random.default_rng(2)
+    row = _l2(np.ones((1, 16), np.float32))
+    base = _l2(rng.standard_normal((40, 16)).astype(np.float32))
+    # Identical rows land on DIFFERENT shards (positions 5, 20, 35 with 8
+    # shards of 5) — the cross-shard exact-tie merge is what's under test.
+    corpus = base.copy()
+    corpus[5] = corpus[20] = corpus[35] = row
+    want = topk_ids(row @ corpus.T, 6)
+    _, ids = ShardedIndex(corpus, mesh=mesh).search(row, 6)
+    np.testing.assert_array_equal(ids, want)
+    assert {5, 20, 35} <= set(ids[0].tolist())  # the tie run, lower id first
+
+
+def test_sharded_k_exceeding_rows_per_shard_and_clamp(mesh):
+    rng = np.random.default_rng(3)
+    corpus = _l2(rng.standard_normal((24, 8)).astype(np.float32))  # 3 rows/shard
+    queries = _l2(rng.standard_normal((4, 8)).astype(np.float32))
+    idx = ShardedIndex(corpus, mesh=mesh)
+    assert idx.rows_per_shard == 3
+    want = topk_ids(queries @ corpus.T, 10)  # k > rows_per_shard
+    _, ids = idx.search(queries, 10)
+    np.testing.assert_array_equal(ids, want)
+    _, ids = idx.search(queries, 1000)  # k clamps to the corpus
+    assert ids.shape == (4, 24)
+    np.testing.assert_array_equal(ids, topk_ids(queries @ corpus.T, 24))
+
+
+def test_sharded_single_query_row_and_custom_ids(mesh):
+    rng = np.random.default_rng(4)
+    corpus = _l2(rng.standard_normal((50, 8)).astype(np.float32))
+    custom = np.arange(50, dtype=np.int64) * 3 + 7  # ascending, non-contiguous
+    idx = ShardedIndex(corpus, custom, mesh=mesh)
+    q = corpus[13]
+    scores, ids = idx.search(q, 5)  # (d,) query squeezes
+    assert scores.shape == ids.shape == (5,)
+    want_pos = topk_ids(q[None] @ corpus.T, 5)[0]
+    np.testing.assert_array_equal(ids, custom[want_pos])
+
+
+def test_sharded_compile_discipline_and_validation(mesh):
+    rng = np.random.default_rng(5)
+    corpus = _l2(rng.standard_normal((64, 8)).astype(np.float32))
+    idx = ShardedIndex(corpus, mesh=mesh, query_buckets=(1, 8))
+    before = idx.compile_count
+    for n in (1, 1, 3, 8, 5):  # mixed sizes inside the bucket grid
+        idx.search(_l2(rng.standard_normal((n, 8)).astype(np.float32)), 5)
+    # Two (query bucket, k_local) points — never one program per request.
+    assert idx.compile_count == before + 2
+    with pytest.raises(ValueError, match="query bucket"):
+        idx.search(np.zeros((9, 8), np.float32), 5)
+    with pytest.raises(ValueError, match="dim"):
+        idx.search(np.zeros((1, 4), np.float32), 5)
+    with pytest.raises(ValueError, match="non-empty"):
+        ShardedIndex(np.zeros((0, 8), np.float32), mesh=mesh)
+    with pytest.raises(ValueError, match=">= 0"):
+        ShardedIndex(corpus, np.full(64, -2), mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# AnnIndex — quantize-then-rerank recall and survivor-order exactness
+# ---------------------------------------------------------------------------
+
+
+def test_ann_int8_recall_floor_at_defaults():
+    """THE acceptance floor: measured recall@10 >= 0.95 at defaults on the
+    test corpus (512 x 32 L2-normalized rows, 64 queries)."""
+    rng = np.random.default_rng(6)
+    corpus = _l2(rng.standard_normal((512, 32)).astype(np.float32))
+    queries = _l2(rng.standard_normal((64, 32)).astype(np.float32))
+    want = topk_ids(queries @ corpus.T, 10)
+    ann = AnnIndex(corpus)
+    _, ids = ann.search(queries, 10)
+    recall = np.mean([
+        len(set(a) & set(e)) / 10
+        for a, e in zip(ids.tolist(), want.tolist())
+    ])
+    assert recall >= 0.95, f"int8 ann recall@10 {recall} under the floor"
+
+
+def test_ann_survivor_order_is_exact():
+    """Where the ann answer recovers the exact top-k set, the ORDER (and the
+    scores) must be identical — the re-rank stage is exact by construction."""
+    rng = np.random.default_rng(7)
+    corpus = _l2(rng.standard_normal((256, 16)).astype(np.float32))
+    queries = _l2(rng.standard_normal((32, 16)).astype(np.float32))
+    exact = topk_ids(queries @ corpus.T, 5)
+    scores, ids = AnnIndex(corpus).search(queries, 5)
+    full = queries @ corpus.T
+    for r in range(len(queries)):
+        if set(ids[r].tolist()) == set(exact[r].tolist()):
+            np.testing.assert_array_equal(ids[r], exact[r])
+            np.testing.assert_allclose(
+                scores[r], full[r, exact[r]], rtol=1e-5
+            )
+
+
+def test_ann_rerank_k_widens_recall_and_full_width_is_exact():
+    rng = np.random.default_rng(8)
+    corpus = _l2(rng.standard_normal((256, 16)).astype(np.float32))
+    queries = _l2(rng.standard_normal((16, 16)).astype(np.float32))
+    ann = AnnIndex(corpus)
+    want = topk_ids(queries @ corpus.T, 10)
+    # rerank_k = corpus size degenerates to the exact path: identical output.
+    _, ids_full = ann.search(queries, 10, rerank_k=256)
+    np.testing.assert_array_equal(ids_full, want)
+
+
+def test_ann_sign_sketch_prunes():
+    """The 1-bit gear: coarse only, so recall needs a wider rerank_k — and
+    at full width it is exact like any pruning gear."""
+    rng = np.random.default_rng(9)
+    corpus = _l2(rng.standard_normal((256, 32)).astype(np.float32))
+    queries = _l2(rng.standard_normal((32, 32)).astype(np.float32))
+    want = topk_ids(queries @ corpus.T, 5)
+    ann = AnnIndex(corpus, coarse="sign")
+    _, ids = ann.search(queries, 5, rerank_k=128)  # prune half the corpus
+    recall = np.mean([
+        len(set(a) & set(e)) / 5 for a, e in zip(ids.tolist(), want.tolist())
+    ])
+    assert recall >= 0.8, f"sign-sketch recall@5 at rk=128: {recall}"
+    _, ids_full = ann.search(queries, 5, rerank_k=256)
+    np.testing.assert_array_equal(ids_full, want)
+
+
+def test_ann_validation():
+    with pytest.raises(ValueError, match="coarse"):
+        AnnIndex(np.eye(4, dtype=np.float32), coarse="fp4")
+    ann = AnnIndex(np.eye(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="dim"):
+        ann.search(np.ones(8, np.float32), 2)
+    with pytest.raises(ValueError, match="k must be"):
+        ann.search(np.ones(4, np.float32), 0)
+
+
+# ---------------------------------------------------------------------------
+# RetrievalRouter — tier routing, recall measurement, stats schema
+# ---------------------------------------------------------------------------
+
+
+def test_router_tiers_agree_with_oracle(mesh):
+    rng = np.random.default_rng(10)
+    corpus = _l2(rng.standard_normal((96, 16)).astype(np.float32))
+    queries = _l2(rng.standard_normal((5, 16)).astype(np.float32))
+    want = topk_ids(queries @ corpus.T, 6)
+    for tier, kw in (
+        ("exact", {}),
+        ("sharded", {"mesh": mesh}),
+        ("ann", {}),
+    ):
+        router = RetrievalRouter(tier=tier, measure_every=1, **kw)
+        assert len(router) == 0
+        with pytest.raises(ValueError, match="publish"):
+            router.search(queries, 6)
+        v = router.publish(corpus)
+        assert v == 1 and len(router) == 96
+        scores, ids, ver = router.search(queries, 6, return_version=True)
+        assert ver == 1
+        np.testing.assert_array_equal(ids, want)  # ann: recall 1.0 here
+        snap = router.stats()
+        assert snap["index_tier"] == tier
+        assert snap["recall_at_k"] == 1.0
+        assert snap["search_stage_latency_ms"]
+    with pytest.raises(ValueError, match="mesh"):
+        RetrievalRouter(tier="sharded")
+    with pytest.raises(ValueError, match="tier"):
+        RetrievalRouter(tier="ivf")
+
+
+def test_router_stats_fields_are_schema_registered():
+    from distributed_sigmoid_loss_tpu.obs.metrics_schema import (
+        SERVE_STATS_FIELDS,
+        validate_metrics,
+    )
+
+    router = RetrievalRouter(tier="ann")
+    router.publish(np.eye(8, dtype=np.float32))
+    router.search(np.eye(8, dtype=np.float32)[0], 3)
+    snap = router.stats()
+    assert validate_metrics(snap, fields=SERVE_STATS_FIELDS, prefixes=()) == []
+    # And the measured-recall machinery reports through the same field.
+    assert snap["rerank_k"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Engine + service over the real tiny towers: the hot-swap drills
+# ---------------------------------------------------------------------------
+
+CTX = 8
+BUCKETS = (1, 4)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    import jax
+    from flax import linen as nn
+
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+    cfg = SigLIPConfig.tiny_test()
+    model = SigLIP(cfg)
+    imgs = np.zeros((1, 16, 16, 3), np.float32)
+    toks = np.zeros((1, CTX), np.int32)
+    params = nn.meta.unbox(model.init(jax.random.key(0), imgs, toks)["params"])
+    eng = InferenceEngine.from_model(model, params, batch_buckets=BUCKETS)
+    eng.warmup()
+    return eng
+
+
+def _perturbed(params, eps, seed):
+    """A same-spec weight tree that provably changes the embeddings (additive
+    noise — a pure rescale would normalize away)."""
+    import jax
+
+    leaves, tree = jax.tree.flatten(params)
+    rng = np.random.default_rng(seed)
+    out = [
+        np.asarray(l) + eps * rng.standard_normal(np.shape(l)).astype(
+            np.asarray(l).dtype
+        )
+        for l in leaves
+    ]
+    return jax.tree.unflatten(tree, out)
+
+
+def test_swap_params_zero_recompiles_and_takes_effect(engine):
+    warmed = engine.compile_count
+    rng = np.random.default_rng(12)
+    toks = rng.integers(0, 64, (3, CTX), dtype=np.int32)
+    before = engine.encode_text(toks)
+    old_params = engine.params
+    try:
+        engine.swap_params(_perturbed(old_params, 0.05, 13))
+        after = engine.encode_text(toks)
+        assert engine.compile_count == warmed  # the zero-recompile contract
+        assert not np.allclose(before, after)  # the new weights actually serve
+        with pytest.raises(ValueError, match="structure"):
+            engine.swap_params({"not": "the tree"})
+        with pytest.raises(ValueError, match="spec"):
+            import jax
+
+            engine.swap_params(
+                jax.tree.map(lambda x: np.asarray(x, np.float64), old_params)
+            )
+    finally:
+        engine.swap_params(old_params)
+
+
+def test_swap_under_concurrent_load(engine):
+    """The acceptance drill: concurrent clients issuing encode+search across
+    >= 3 hot swaps — zero request errors, every client's observed version
+    sequence monotonically non-decreasing, compile_count flat."""
+    rng = np.random.default_rng(14)
+    corpus_toks = rng.integers(0, 64, (24, CTX), dtype=np.int32)
+    corpus = np.concatenate(
+        [engine.encode_text(corpus_toks[i : i + 4]) for i in range(0, 24, 4)]
+    )
+    router = RetrievalRouter(tier="ann", measure_every=4)
+    router.publish(corpus)
+    old_params = engine.params
+    warmed = engine.compile_count
+    ctl = SwapController(engine, router)
+
+    errors: list = []
+    versions: dict[int, list[int]] = {}
+    start = threading.Barrier(5)
+    try:
+        with EmbeddingService(engine, index=router, max_wait_ms=2.0) as svc:
+
+            def client(cid: int):
+                crng = np.random.default_rng(100 + cid)
+                seen = []
+                try:
+                    start.wait(timeout=10)
+                    for _ in range(25):
+                        q = crng.integers(0, 64, CTX, dtype=np.int32)
+                        _, ids, ver = svc.search(
+                            q, k=3, return_version=True
+                        )
+                        assert ids.shape[-1] == 3
+                        seen.append(ver)
+                except Exception as e:  # noqa: BLE001 — the drill counts them
+                    errors.append(e)
+                versions[cid] = seen
+
+            threads = [
+                threading.Thread(target=client, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            start.wait(timeout=10)
+            for j in range(3):  # >= 3 swaps while traffic is live
+                ctl.swap(
+                    params=_perturbed(old_params, 0.02, 20 + j),
+                    embeddings=corpus,
+                )
+            for t in threads:
+                t.join(timeout=60)
+            snap = svc.stats()
+    finally:
+        engine.swap_params(old_params)
+
+    assert errors == [], errors
+    assert router.version >= 4  # initial publish + 3 swaps
+    for cid, seen in versions.items():
+        assert len(seen) == 25
+        assert all(a <= b for a, b in zip(seen, seen[1:])), (cid, seen)
+    # Zero new compiles across every swap, with live traffic in flight.
+    assert engine.compile_count == warmed
+    assert snap["swap_count"] == 3
+    assert snap["index_version"] == router.version
+    assert snap["swap_latency_ms"]["p50_ms"] >= 0.0
+
+
+def test_router_and_swap_emit_graftscope_spans(mesh, engine):
+    """The new serving stages land on the graftscope host timeline:
+    serve/search/{fanout,merge,coarse,rerank} per tier + serve/swap."""
+    from distributed_sigmoid_loss_tpu.obs import SpanRecorder
+
+    rng = np.random.default_rng(15)
+    corpus = _l2(rng.standard_normal((32, 8)).astype(np.float32))
+    spans = SpanRecorder()
+    sharded = RetrievalRouter(tier="sharded", mesh=mesh, spans=spans)
+    sharded.publish(corpus)
+    sharded.search(corpus[0], 3)
+    ann = RetrievalRouter(tier="ann", spans=spans)
+    ann.publish(corpus)
+    ann.search(corpus[0], 3)
+    SwapController(engine, ann).swap(embeddings=corpus)
+    names = {s.name for s in spans.spans()}
+    assert {
+        "serve/search/fanout", "serve/search/merge",
+        "serve/search/coarse", "serve/search/rerank", "serve/swap",
+    } <= names, names
+
+
+def test_swap_through_load_forward_artifact_engine(tmp_path):
+    """New weights via the exported-forward serving path: the engine built
+    from a ``train.load_forward`` artifact accepts a hot swap with zero
+    recompiles, and the swapped weights actually change the embeddings."""
+    import jax
+    from flax import linen as nn
+
+    from distributed_sigmoid_loss_tpu.cli import main as cli_main
+    from distributed_sigmoid_loss_tpu.data import SyntheticImageText
+    from distributed_sigmoid_loss_tpu.models import SigLIP
+    from distributed_sigmoid_loss_tpu.train import load_forward
+    from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
+
+    b = 4
+    art = str(tmp_path / "fwd.bin")
+    assert cli_main(
+        ["export", art, "--what", "forward", "--tiny", "--batch", str(b)]
+    ) == 0
+
+    cfg = SigLIPConfig.tiny_test()
+    ctx = cfg.text.context_length
+    batch = next(iter(SyntheticImageText(cfg, b)))
+    model = SigLIP(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.key(0), batch["images"], batch["tokens"])[
+            "params"
+        ]
+    )
+    fwd = load_forward(art)
+    zero_imgs = np.zeros((b, 16, 16, 3), np.float32)
+    zero_toks = np.zeros((b, ctx), np.int32)
+    eng = InferenceEngine(
+        lambda p, im: fwd(p, im, zero_toks)[0],
+        lambda p, tk: fwd(p, zero_imgs, tk)[1],
+        params,
+        batch_buckets=(b,),
+        text_len_buckets=(ctx,),
+        image_shape=(16, 16, 3),
+    )
+    warmed = eng.warmup()
+    toks = np.asarray(batch["tokens"], np.int32)
+    before = eng.encode_text(toks)
+    eng.swap_params(_perturbed(params, 0.05, 30))
+    after = eng.encode_text(toks)
+    assert eng.compile_count == warmed == eng.bucket_space
+    assert not np.allclose(before, after)

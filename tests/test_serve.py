@@ -395,6 +395,52 @@ def test_index_validates_inputs():
     assert ids.shape == (4,)
 
 
+def test_index_snapshot_isolation_under_concurrent_add():
+    """THE snapshot pin: an add() landing MID-chunked-scan must be invisible
+    to that search — the result is exactly the rows present when the search
+    started (a consistent prefix), never a torn chunk mixing generations.
+
+    Interleaving is forced deterministically: the chunk generator is gated
+    so a concurrent add() provably completes between chunk 1 and chunk 2 of
+    a live scan.
+    """
+    from distributed_sigmoid_loss_tpu.eval.retrieval import topk_ids
+
+    rng = np.random.default_rng(6)
+    first = _l2(rng.standard_normal((32, 8)).astype(np.float32))
+    second = _l2(rng.standard_normal((32, 8)).astype(np.float32))
+    queries = _l2(rng.standard_normal((4, 8)).astype(np.float32))
+
+    idx = RetrievalIndex(chunk_size=8)
+    idx.add(first)
+
+    orig_chunks = idx._chunks
+    added_mid_scan = threading.Event()
+
+    def gated_chunks(blocks, id_blocks):
+        it = orig_chunks(blocks, id_blocks)
+        yield next(it)  # chunk 1 of the snapshot is already consumed...
+        adder = threading.Thread(target=lambda: idx.add(second))
+        adder.start()
+        adder.join(timeout=10)  # ...now 32 new rows land, mid-scan
+        added_mid_scan.set()
+        yield from it
+
+    idx._chunks = gated_chunks
+    scores, ids = idx.search(queries, k=10)
+    idx._chunks = orig_chunks
+
+    assert added_mid_scan.is_set()
+    # Consistent prefix: identical to a search over ONLY the first block —
+    # no id from the mid-scan add, no torn chunk.
+    np.testing.assert_array_equal(ids, topk_ids(queries @ first.T, 10))
+    assert ids.max() < 32
+    # And a fresh search sees the full post-add corpus.
+    corpus = np.concatenate([first, second])
+    _, ids_after = idx.search(queries, k=10)
+    np.testing.assert_array_equal(ids_after, topk_ids(queries @ corpus.T, 10))
+
+
 # ---------------------------------------------------------------------------
 # serve-bench CLI — the acceptance entry point, scaled down for CI
 # ---------------------------------------------------------------------------
@@ -522,7 +568,8 @@ def test_cli_serve_bench_prints_stats_snapshot(tmp_path):
     proc = subprocess.run(
         [sys.executable, "-m", "distributed_sigmoid_loss_tpu", "serve-bench",
          "--requests", "48", "--clients", "4", "--pool", "16",
-         "--index-size", "16", "--batch-buckets", "1,4,8"],
+         "--index-size", "16", "--batch-buckets", "1,4,8",
+         "--index-tier", "ann", "--swap-every", "12"],
         capture_output=True, text=True, timeout=420, env=env, cwd=repo,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -534,6 +581,16 @@ def test_cli_serve_bench_prints_stats_snapshot(tmp_path):
         assert key in record, key
     assert "p99_ms" in record["latency_ms"]
     assert 0.0 <= record["cache"]["hit_rate"] <= 1.0
-    # The serving contract: compiles == warmed shape buckets, NOT requests.
+    # The serving contract: compiles == warmed shape buckets, NOT requests —
+    # which --swap-every churn must hold too (the runner exits 1 otherwise).
     assert record["compile_count"] == record["bucket_space"] == 3 * 2
     assert record["compile_count"] < record["requests"]
+    # The distindex churn fields ride the schema-validated record path.
+    assert record["index_tier"] == "ann"
+    assert record["swap_every"] == 12
+    assert record["swap_count"] >= 1
+    assert record["index_version"] == record["swap_count"] + 1
+    assert "p99_ms" in record["swap_latency_ms"]
+    assert record["rerank_k"] > 0
+    if record["recall_at_k"] is not None:
+        assert 0.0 <= record["recall_at_k"] <= 1.0
