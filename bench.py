@@ -146,7 +146,14 @@ def _emit(record: dict, flush: bool = False) -> None:
     (analysis/bench_schema.py) — every emit path goes through here so record
     fields cannot drift per path. A violation warns on stderr but still
     prints: a measurement must never be lost to its own validator (the
-    repo-bench-record lint rule catches the drift statically in tier-1)."""
+    repo-bench-record lint rule catches the drift statically in tier-1).
+
+    Every record ALSO lands in the append-only run ledger (obs/ledger.py:
+    record + environment fingerprint + explicit ok/no-backend/deferred
+    status) — the longitudinal half the one-shot stdout contract never had.
+    The graftlint rule ``repo-ledger-emit`` enforces statically that record
+    prints happen only here, so no emit path can bypass the ledger.
+    """
     try:
         # Function-level import: bench.py's TOP-LEVEL imports stay stdlib-only
         # (tests import it without initializing jax); by emit time the heavy
@@ -164,6 +171,13 @@ def _emit(record: dict, flush: bool = False) -> None:
             file=sys.stderr,
         )
     print(json.dumps(record), flush=flush)
+    try:
+        from distributed_sigmoid_loss_tpu.obs.ledger import append_record
+
+        append_record(record, problems=problems)
+    except Exception as e:  # noqa: BLE001 — the ledger never kills a record
+        print(f"WARNING: ledger append failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
 
 
 def emit_backend_error(args, error: str) -> None:
@@ -1130,6 +1144,7 @@ def run_serve_bench_mode(args) -> int:
         max_queue=1024, cache_size=4096, pool=64,
         index_size=256, topk=10, seed=0, mesh=False, cpu_devices=0,
         index_tier=args.index_tier, swap_every=args.swap_every, rerank_k=0,
+        metrics_port=-1,
     )
     if args.index_tier == "sharded":
         import jax

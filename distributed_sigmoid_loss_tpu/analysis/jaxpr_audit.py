@@ -628,10 +628,20 @@ def _abstract_state(model, tx, batch, ef_slices: int | None = None):
     return state
 
 
+# Memo for step_config_jaxprs keyed by the RESOLVED mesh size: the traces
+# are deterministic (tiny towers, abstract state, fixed mesh), and the
+# auditor, obs/attribution, and obs/regress all enumerate the same fifteen
+# configs — one tier-1 run used to pay the ~22 s trace three times over.
+# Host-side only; never read inside traced code (allowlisted in repo_lint).
+_STEP_CONFIG_CACHE: dict = {}
+
+
 def step_config_jaxprs(n_devices: int | None = None) -> dict:
     """label -> (closed_jaxpr, audit_kwargs) for the fifteen step configs,
     traced on virtual CPU devices. Trace-only: tiny towers, abstract
-    state/batch — seconds, not the minutes a compile would cost."""
+    state/batch — seconds, not the minutes a compile would cost. Traces are
+    memoized per resolved mesh size (deterministic; a shallow copy is
+    returned so callers can't disturb the memo)."""
     import dataclasses
 
     import jax
@@ -659,6 +669,8 @@ def step_config_jaxprs(n_devices: int | None = None) -> dict:
             f"all fifteen step configs (got {n_devices}; run under "
             f"--xla_force_host_platform_device_count or lint --cpu-devices)"
         )
+    if n_devices in _STEP_CONFIG_CACHE:
+        return dict(_STEP_CONFIG_CACHE[n_devices])
     dp_mesh = Mesh(np.asarray(devices[:n_devices]), ("dp",))
     dcn_mesh = Mesh(
         np.asarray(devices[:n_devices]).reshape(2, n_devices // 2),
@@ -791,7 +803,8 @@ def step_config_jaxprs(n_devices: int | None = None) -> dict:
     for label, (st, bt, build, kwargs) in builds.items():
         step = build()
         out[label] = (jax.make_jaxpr(step)(st, bt), kwargs)
-    return out
+    _STEP_CONFIG_CACHE[n_devices] = out
+    return dict(out)
 
 
 def audit_default_step_configs(n_devices: int | None = None) -> list[Finding]:

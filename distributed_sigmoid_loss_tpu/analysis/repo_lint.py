@@ -24,6 +24,11 @@ review:
   ``obs/metrics_schema.py`` — the same drift class as repo-bench-record, for
   the OTHER two record streams (a metric added in one step builder but not
   declared is invisible to every downstream parser until it breaks one).
+- ``repo-ledger-emit``: bench.py's record prints (``print(json.dumps(...))``)
+  may happen ONLY inside ``_emit``, and ``_emit`` must append to the run
+  ledger (``obs/ledger.py append_record``) — a new emit path that prints its
+  own JSON bypasses both the schema validator and the perf trajectory, the
+  blind-spot class rounds 4/5 recorded 0.0 into.
 
 All checks take explicit source/path inputs so tests can falsify each rule on
 a known-bad fixture; the defaults audit the real repo.
@@ -45,6 +50,7 @@ __all__ = [
     "check_slow_markers",
     "check_bench_record_fields",
     "check_metrics_schema",
+    "check_ledger_emit",
     "MUTABLE_GLOBAL_ALLOWLIST",
     "SLOW_REQUIRED_TEST_MODULES",
     "METRICS_SCHEMA_FILES",
@@ -57,6 +63,7 @@ REPO_RULES = (
     "repo-slow-marker",
     "repo-bench-record",
     "repo-metrics-schema",
+    "repo-ledger-emit",
 )
 
 _PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -95,6 +102,17 @@ MUTABLE_GLOBAL_ALLOWLIST = {
     "data/native_decode.py::_lib_failed": (
         "host-side build-failure latch paired with _lib; never read inside "
         "traced code"
+    ),
+    "obs/ledger.py::_FINGERPRINT_CACHE": (
+        "host-side memo for the ledger's environment fingerprint (git sha "
+        "subprocess result); never read inside traced code — the ledger is "
+        "a stdlib emit path"
+    ),
+    "analysis/jaxpr_audit.py::_STEP_CONFIG_CACHE": (
+        "host-side memo of the deterministic fifteen-config trace "
+        "(auditor + obs/attribution + obs/regress share one enumeration; "
+        "the ~22 s trace used to run 3x per tier-1); never read inside "
+        "traced code — it CONTAINS closed jaxprs, which are inert data"
     ),
 }
 
@@ -705,6 +723,96 @@ def check_metrics_schema(sources=None, files=None) -> list[Finding]:
     return findings
 
 
+def _json_record_prints(tree: ast.Module) -> dict[str, list[int]]:
+    """function_name -> lines where ``print(json.dumps(...))`` (or
+    ``print(dumps(...))``) occurs — the record-emit signature the ledger rule
+    keys on. Module-level prints land under the pseudo-name ``<module>``."""
+
+    def is_dumps(call: ast.AST) -> bool:
+        if not isinstance(call, ast.Call):
+            return False
+        f = call.func
+        return (isinstance(f, ast.Attribute) and f.attr == "dumps") or (
+            isinstance(f, ast.Name) and f.id == "dumps"
+        )
+
+    out: dict[str, list[int]] = {}
+
+    def visit(node: ast.AST, owner: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            name = owner
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            if (
+                isinstance(child, ast.Call)
+                and isinstance(child.func, ast.Name)
+                and child.func.id == "print"
+                and child.args
+                and is_dumps(child.args[0])
+            ):
+                out.setdefault(owner, []).append(child.lineno)
+            visit(child, name)
+
+    visit(tree, "<module>")
+    return out
+
+
+def check_ledger_emit(bench_source: str | None = None) -> list[Finding]:
+    """repo-ledger-emit: every bench.py record print routes through the ONE
+    ledger-appending emitter.
+
+    Two statically-checkable halves: (a) ``_emit`` must call the ledger
+    append (``append_record``); (b) no ``print(json.dumps(...))`` may appear
+    outside ``_emit`` — a path printing its own JSON bypasses the ledger (and
+    the schema validator) exactly the way pre-round-4 emit paths drifted.
+    """
+    if bench_source is None:
+        with open(os.path.join(_REPO_ROOT, "bench.py"), encoding="utf-8") as f:
+            bench_source = f.read()
+    tree = ast.parse(bench_source)
+    findings = []
+    emit_fns = [
+        node for node in ast.walk(tree)
+        if isinstance(node, ast.FunctionDef) and node.name == "_emit"
+    ]
+    if not emit_fns:
+        findings.append(Finding(
+            "repo-ledger-emit", "bench.py::_emit",
+            "no _emit function found — bench.py has no single schema-"
+            "validating, ledger-appending emit path",
+        ))
+    else:
+        calls_append = any(
+            isinstance(node, ast.Call)
+            and (
+                (isinstance(node.func, ast.Name)
+                 and node.func.id == "append_record")
+                or (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append_record")
+            )
+            for node in ast.walk(emit_fns[0])
+        )
+        if not calls_append:
+            findings.append(Finding(
+                "repo-ledger-emit", "bench.py::_emit",
+                "_emit does not call obs.ledger append_record — records "
+                "print to stdout but never enter the perf trajectory; the "
+                "next backend outage is invisible again (the BENCH_r04/r05 "
+                "blind spot)",
+            ))
+    for owner, lines in sorted(_json_record_prints(tree).items()):
+        if owner == "_emit":
+            continue
+        for line in lines:
+            findings.append(Finding(
+                "repo-ledger-emit", f"bench.py::{owner}",
+                f"print(json.dumps(...)) at line {line} outside _emit — a "
+                "record emit path bypassing the ledger append (and the "
+                "schema validator); route it through _emit",
+            ))
+    return findings
+
+
 def run_repo_lint(disabled=()) -> list[Finding]:
     """Run every repo rule against the real tree."""
     checks = {
@@ -714,6 +822,7 @@ def run_repo_lint(disabled=()) -> list[Finding]:
         "repo-slow-marker": check_slow_markers,
         "repo-bench-record": check_bench_record_fields,
         "repo-metrics-schema": check_metrics_schema,
+        "repo-ledger-emit": check_ledger_emit,
     }
     findings: list[Finding] = []
     for rule, fn in checks.items():

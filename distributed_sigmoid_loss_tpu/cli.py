@@ -933,6 +933,36 @@ def cmd_train(args) -> int:
             put=lambda b, m, a: place_spanned(b), stats=input_stats,
         )
 
+    # Soak-run telemetry (graftledger): under --obs-dir the latest metrics
+    # line is ALSO mirrored into DIR/telemetry.json via atomic rename each
+    # log interval — tail the run's live state without parsing (or racing)
+    # the metrics log stream.
+    telemetry_env = None
+    if args.obs_dir:
+        from distributed_sigmoid_loss_tpu.obs.ledger import (
+            environment_fingerprint,
+        )
+
+        telemetry_env = environment_fingerprint()
+
+    def write_telemetry(step_i, line):
+        if not args.obs_dir or step_i % args.log_every:
+            return
+        import time as _time
+
+        from distributed_sigmoid_loss_tpu.obs.telemetry import (
+            write_telemetry_file,
+        )
+
+        try:
+            write_telemetry_file(
+                os.path.join(args.obs_dir, "telemetry.json"),
+                {"step": step_i, "ts": round(_time.time(), 3),
+                 "metrics": line, "env": telemetry_env},
+            )
+        except OSError as e:  # telemetry must never kill a training run
+            print(f"WARNING: telemetry write failed: {e}", file=sys.stderr)
+
     def log_metrics(step_i, m):
         line = {
             **{k: float(v) for k, v in m.items()},
@@ -945,6 +975,7 @@ def cmd_train(args) -> int:
                 logger.write(ev.record(), schema=HEALTH_EVENT_FIELDS)
         flight.note_metrics(step_i, line)
         logger.log(step_i, line)
+        write_telemetry(step_i, line)
 
     eval_hook = None
     if args.eval_every:
@@ -1558,6 +1589,13 @@ def cmd_serve_bench(args) -> int:
         default_timeout=60.0,
         logger=MetricsLogger(),
     )
+    if args.metrics_port >= 0:
+        # Live pull-based telemetry DURING the bench: the OpenMetrics-style
+        # /metrics endpoint (obs/telemetry.py) on a stdlib HTTP thread —
+        # scrape it mid-run instead of waiting for the final JSON record.
+        exporter = service.start_metrics_server(port=args.metrics_port)
+        print(f"serve-bench: live /metrics at {exporter.url}",
+              file=sys.stderr)
 
     # --swap-every N churn: a swapper thread republishes the weights and
     # freshly built index segments after every N completed client ops —
@@ -1642,6 +1680,11 @@ def cmd_serve_bench(args) -> int:
         print("WARNING: serve-bench record schema violation: "
               + "; ".join(problems), file=sys.stderr)
     print(json.dumps(record))
+    # graftledger: serve-bench records join the same append-only trajectory
+    # as the train headline (obs/ledger.py; never fatal to the measurement).
+    from distributed_sigmoid_loss_tpu.obs.ledger import append_record
+
+    append_record(record, source="serve-bench", problems=problems)
     # Steady-state contract: every compile happened at warmup — one per shape
     # bucket. A violation means a request escaped the bucket grid.
     if snap["compile_count"] != warmed:
@@ -1664,33 +1707,20 @@ def cmd_data_bench(args) -> int:
     return run_data_bench(args)
 
 
-def cmd_obs(args) -> int:
-    """``obs summarize DIR``: one merged offline report of a run's host spans
-    (``host_spans.trace.json`` written by ``train --obs-dir``) and any device
-    trace capture (``*.trace.json.gz`` from ``utils.profiling.trace`` /
-    ``bench --profile``) found under DIR — the unified graftscope timeline,
-    no TensorBoard needed. ``--merged-out`` additionally writes one combined
-    Chrome-trace JSON that opens in ui.perfetto.dev with host and device
-    tracks side by side.
-    """
+def _load_host_spans(root: str):
+    """(host_trace, spans) aggregated from every host_spans.trace.json under
+    ``root`` — shared by `obs summarize` and the span half of `obs diff`."""
     import glob as globmod
     import json as jsonmod
 
-    if args.action != "summarize":
-        print(f"unknown obs action {args.action!r}", file=sys.stderr)
-        return 2
-    from distributed_sigmoid_loss_tpu.obs.spans import (
-        Span,
-        merge_chrome_traces,
-        summarize_spans,
-    )
+    from distributed_sigmoid_loss_tpu.obs.spans import Span
 
     host_trace = None
     host_paths = sorted(
-        globmod.glob(os.path.join(args.dir, "**", "host_spans.trace.json"),
+        globmod.glob(os.path.join(root, "**", "host_spans.trace.json"),
                      recursive=True)
     )
-    spans: list[Span] = []
+    spans: list = []
     if host_paths:
         host_trace = {"traceEvents": []}
         for path in host_paths:
@@ -1702,14 +1732,240 @@ def cmd_obs(args) -> int:
                 t0 = ev["ts"] / 1e6
                 spans.append(Span(ev["name"], t0, t0 + ev["dur"] / 1e6,
                                   ev.get("tid", 0)))
+    return host_trace, host_paths, spans
+
+
+def cmd_obs(args) -> int:
+    """The graftscope/graftledger offline surface:
+
+    - ``obs summarize DIR`` — merged host-span + device-trace report.
+    - ``obs ledger`` — the per-metric perf trajectory from the append-only
+      run ledger (no-backend/deferred/error rounds listed but excluded from
+      the baseline stats); ``--backfill`` seeds it from the committed
+      BENCH_r*/MULTICHIP_r* round files.
+    - ``obs diff A B`` — field-level diff of two records (ledger selectors
+      like ``metric@-1``, entry indices, or record-JSON paths) or of two
+      run directories' span summaries.
+    - ``obs regress`` — the chip-free proxy regression gate
+      (obs/regress.py) against the committed baseline; ``--update``
+      regenerates the baseline on the 8-virtual-device CPU mesh.
+    """
+    if args.action == "ledger":
+        return _obs_ledger(args)
+    if args.action == "diff":
+        return _obs_diff(args)
+    if args.action == "regress":
+        return _obs_regress(args)
+    return _obs_summarize(args)
+
+
+def _obs_ledger(args) -> int:
+    from distributed_sigmoid_loss_tpu.obs.ledger import (
+        backfill_round_files,
+        ledger_path,
+        read_ledger,
+        trajectory,
+        trajectory_summary,
+    )
+
+    path = args.ledger or None
+    if args.backfill:
+        added = backfill_round_files(path=path)
+        print(f"backfilled {len(added)} entr(y/ies) from the committed "
+              f"round files -> {ledger_path(path)}", file=sys.stderr)
+    entries = read_ledger(path)
+    if not entries:
+        print(f"ledger {ledger_path(path)!r} is empty (bench runs append "
+              "automatically; seed history with `obs ledger --backfill`)",
+              file=sys.stderr)
+        return 2
+    traj = trajectory(entries, metric=args.metric or None)
+    if not traj:
+        print(f"no entries for metric {args.metric!r}", file=sys.stderr)
+        return 2
+    for metric in sorted(traj):
+        points = traj[metric]
+        print(f"== {metric} ({len(points)} entr(y/ies))")
+        for p in points:
+            rnd = f"r{p['round']:02d}" if p.get("round") is not None else "  -"
+            val = p.get("value")
+            val_s = f"{val:>12.2f}" if isinstance(val, (int, float)) else (
+                f"{val!r:>12}"
+            )
+            extra = p.get("device_kind", "")
+            print(f"  {rnd:>4} {val_s} {p.get('unit', ''):<13}"
+                  f"{p['status']:<12}{p['source']:<28}{extra}")
+        s = trajectory_summary(points)
+        if s["n"]:
+            last = s["last"]
+            print(f"  -> baseline over {s['n']} measured "
+                  f"(excluded {s['excluded']} non-measurement): "
+                  f"last {last['value']} ({last.get('status')}), "
+                  f"best {s['best']}, mean {round(s['mean'], 2)}")
+        else:
+            print(f"  -> no measured entries ({s['excluded']} excluded: "
+                  "outages/deferrals are not baselines)")
+    return 0
+
+
+def _resolve_diff_operand(op: str, entries):
+    """One `obs diff` operand -> ("record", dict) | ("spans", dir).
+
+    Accepts: a run directory (span summaries), a JSON file (a raw record, a
+    ledger entry, or a driver round file whose ``tail`` holds record lines),
+    ``metric@N`` (the N-th ledger entry of that metric, negatives from the
+    end), or a bare integer (global ledger entry index).
+    """
+    import json as jsonmod
+
+    from distributed_sigmoid_loss_tpu.obs.ledger import _records_in_tail
+
+    if os.path.isdir(op):
+        return "spans", op
+    if os.path.exists(op):
+        with open(op, encoding="utf-8") as f:
+            data = jsonmod.load(f)
+        if not isinstance(data, dict):
+            raise ValueError(f"{op}: not a JSON object")
+        if "metric" in data:
+            return "record", data
+        if isinstance(data.get("record"), dict):
+            return "record", data["record"]
+        if "tail" in data:
+            recs = _records_in_tail(data.get("tail", ""))
+            if recs:
+                return "record", recs[-1]
+        raise ValueError(f"{op}: no bench record found in the file")
+    if "@" in op:
+        metric, _, idx_s = op.rpartition("@")
+        matching = [e for e in entries
+                    if e.get("record", {}).get("metric") == metric]
+        if not matching:
+            raise ValueError(f"no ledger entries for metric {metric!r}")
+        try:
+            return "record", matching[int(idx_s)]["record"]
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"{op}: index {idx_s!r} out of range "
+                f"({len(matching)} entr(y/ies) for {metric!r})"
+            ) from None
+    try:
+        return "record", entries[int(op)]["record"]
+    except ValueError:
+        raise ValueError(
+            f"{op}: not a path, metric@N selector, or entry index"
+        ) from None
+    except IndexError:
+        raise ValueError(
+            f"{op}: ledger has {len(entries)} entr(y/ies)"
+        ) from None
+
+
+def _obs_diff(args) -> int:
+    from distributed_sigmoid_loss_tpu.obs.ledger import (
+        diff_records,
+        read_ledger,
+    )
+
+    if len(args.paths) != 2:
+        print("obs diff needs exactly two operands (ledger selector "
+              "metric@N, entry index, record-JSON path, or run dir)",
+              file=sys.stderr)
+        return 2
+    entries = read_ledger(args.ledger or None)
+    try:
+        (kind_a, a), (kind_b, b) = (
+            _resolve_diff_operand(op, entries) for op in args.paths
+        )
+    except ValueError as e:
+        print(f"obs diff: {e}", file=sys.stderr)
+        return 2
+    if {kind_a, kind_b} == {"spans"}:
+        from distributed_sigmoid_loss_tpu.obs.spans import summarize_spans
+
+        rows_a = summarize_spans(_load_host_spans(a)[2])
+        rows_b = summarize_spans(_load_host_spans(b)[2])
+        if not rows_a or not rows_b:
+            print("obs diff: one of the run dirs has no host spans "
+                  "(train with --obs-dir)", file=sys.stderr)
+            return 2
+        print(f"== span summary diff (A={a} B={b})")
+        print(f"  {'span':<28}{'A mean ms':>11}{'B mean ms':>11}{'delta':>9}")
+        for name in sorted(set(rows_a) | set(rows_b)):
+            ma = rows_a.get(name, {}).get("mean_ms")
+            mb = rows_b.get(name, {}).get("mean_ms")
+            if ma is None or mb is None:
+                only = "A" if mb is None else "B"
+                print(f"  {name:<28}{'(only in ' + only + ')':>31}")
+                continue
+            print(f"  {name:<28}{ma:>11.2f}{mb:>11.2f}{mb - ma:>+9.2f}")
+        return 0
+    if kind_a != "record" or kind_b != "record":
+        print("obs diff: cannot diff a run dir against a record — pass two "
+              "of the same kind", file=sys.stderr)
+        return 2
+    d = diff_records(a, b)
+    print(f"== record diff (A={args.paths[0]} B={args.paths[1]})")
+    for k, entry in d["changed"].items():
+        delta = ""
+        if "rel" in entry:
+            delta = f"  ({entry['delta']:+g}, {entry['rel']:+.1%})"
+        elif "delta" in entry:
+            delta = f"  ({entry['delta']:+g})"
+        print(f"  {k:<28}{entry['a']!r} -> {entry['b']!r}{delta}")
+    if d["added"]:
+        print(f"  only in B: {', '.join(d['added'])}")
+    if d["removed"]:
+        print(f"  only in A: {', '.join(d['removed'])}")
+    if not (d["changed"] or d["added"] or d["removed"]):
+        print("  records are identical")
+    return 0
+
+
+def _obs_regress(args) -> int:
+    # Same bootstrap discipline as `lint`: the lattice traces shard_map'd
+    # steps, which needs the multi-device virtual mesh.
+    if not args.cpu_devices:
+        args.cpu_devices = 8
+    _bootstrap_devices(args)
+    from distributed_sigmoid_loss_tpu.obs.regress import run_regress
+
+    return run_regress(
+        baseline_path=args.baseline or None,
+        update=args.update,
+    )
+
+
+def _obs_summarize(args) -> int:
+    """``obs summarize DIR``: one merged offline report of a run's host spans
+    (``host_spans.trace.json`` written by ``train --obs-dir``) and any device
+    trace capture (``*.trace.json.gz`` from ``utils.profiling.trace`` /
+    ``bench --profile``) found under DIR — the unified graftscope timeline,
+    no TensorBoard needed. ``--merged-out`` additionally writes one combined
+    Chrome-trace JSON that opens in ui.perfetto.dev with host and device
+    tracks side by side.
+    """
+    import glob as globmod
+    import json as jsonmod
+
+    if len(args.paths) != 1:
+        print("obs summarize needs exactly one DIR operand", file=sys.stderr)
+        return 2
+    root = args.paths[0]
+    from distributed_sigmoid_loss_tpu.obs.spans import (
+        merge_chrome_traces,
+        summarize_spans,
+    )
+
+    host_trace, host_paths, spans = _load_host_spans(root)
 
     device_files = globmod.glob(
-        os.path.join(args.dir, "**", "*.trace.json.gz"), recursive=True
+        os.path.join(root, "**", "*.trace.json.gz"), recursive=True
     )
 
     if not spans and not device_files:
         print(f"no host_spans.trace.json or *.trace.json.gz under "
-              f"{args.dir!r} (train with --obs-dir and/or capture a device "
+              f"{root!r} (train with --obs-dir and/or capture a device "
               "trace with utils.profiling.trace / bench --profile)",
               file=sys.stderr)
         return 2
@@ -1729,7 +1985,7 @@ def cmd_obs(args) -> int:
             summarize_device_ops,
         )
 
-        dev = summarize_device_ops(args.dir, top=args.top)
+        dev = summarize_device_ops(root, top=args.top)
         if dev["categories"]:
             print("\n== device ops by hlo_category "
                   "(achieved rates over span time)")
@@ -1751,7 +2007,7 @@ def cmd_obs(args) -> int:
             _read_trace_files,
         )
 
-        device_events = _read_trace_files(args.dir) if device_files else ()
+        device_events = _read_trace_files(root) if device_files else ()
         merged = merge_chrome_traces(host_trace or {"traceEvents": []},
                                      device_events)
         with open(args.merged_out, "w", encoding="utf-8") as f:
@@ -2192,6 +2448,12 @@ def main(argv=None) -> int:
                          "re-rank (0 = auto: max(8·topk, 64)) — the "
                          "recall/latency knob")
     sb.add_argument("--topk", type=int, default=5)
+    sb.add_argument("--metrics-port", type=int, default=-1, metavar="PORT",
+                    help="expose the live OpenMetrics-style /metrics "
+                         "endpoint during the bench on this port (0 = an "
+                         "ephemeral port, printed on stderr; -1 = off) — "
+                         "scrape qps/latency/compile_count mid-run "
+                         "(docs/OBSERVABILITY.md 'graftledger')")
     sb.add_argument("--seed", type=int, default=0)
     sb.add_argument("--mesh", action="store_true",
                     help="shard engine batches over the dp mesh (batch "
@@ -2217,21 +2479,49 @@ def main(argv=None) -> int:
 
     ob = sub.add_parser(
         "obs",
-        help="graftscope offline reports: `obs summarize DIR` merges the "
-             "host spans a --obs-dir run wrote with any device trace "
-             "capture under DIR into one where-the-time-goes report "
-             "(docs/OBSERVABILITY.md)",
+        help="graftscope/graftledger reports: `obs summarize DIR` (merged "
+             "host+device timeline), `obs ledger` (the perf trajectory from "
+             "the append-only run ledger), `obs diff A B` (record or span "
+             "diffs), `obs regress` (chip-free proxy regression gate vs the "
+             "committed baseline) — docs/OBSERVABILITY.md",
     )
-    ob.add_argument("action", choices=["summarize"],
+    ob.add_argument("action",
+                    choices=["summarize", "ledger", "diff", "regress"],
                     help="summarize: aggregate host spans + device op time "
-                         "found under DIR")
-    ob.add_argument("dir", help="directory holding host_spans.trace.json "
-                                "and/or *.trace.json.gz captures")
+                         "under DIR; ledger: per-metric trajectory summary; "
+                         "diff: field-level diff of two records or two run "
+                         "dirs' span summaries; regress: proxy metrics vs "
+                         "the committed baseline (exit 1 on regression)")
+    ob.add_argument("paths", nargs="*",
+                    help="summarize: DIR; diff: two operands (metric@N "
+                         "ledger selector, entry index, record-JSON path, "
+                         "or run dir); ledger/regress: none")
     ob.add_argument("--top", type=int, default=12,
                     help="rows per device-op table (obs summarize)")
     ob.add_argument("--merged-out", default="", metavar="PATH",
                     help="also write one merged Chrome-trace JSON (host + "
                          "device events; open in ui.perfetto.dev)")
+    ob.add_argument("--ledger", default="", metavar="PATH",
+                    help="ledger file for `obs ledger`/`obs diff` (default: "
+                         "DSL_LEDGER_PATH or LEDGER.jsonl at the repo root)")
+    ob.add_argument("--metric", default="", metavar="NAME",
+                    help="restrict `obs ledger` to one metric stream")
+    ob.add_argument("--backfill", action="store_true",
+                    help="before summarizing, seed the ledger from the "
+                         "committed BENCH_r*/MULTICHIP_r* round files "
+                         "(idempotent; rounds whose backend was down land "
+                         "as status=no-backend)")
+    ob.add_argument("--baseline", default="", metavar="PATH",
+                    help="`obs regress`: baseline file (default: the "
+                         "committed obs/regress_baseline.json)")
+    ob.add_argument("--update", action="store_true",
+                    help="`obs regress`: regenerate the baseline from the "
+                         "current tree instead of comparing (commit the "
+                         "result with the change that moved it)")
+    ob.add_argument("--cpu-devices", type=int, default=0,
+                    help="`obs regress`: virtual CPU mesh size (default 8 — "
+                         "the same deterministic mesh the committed "
+                         "baseline was generated on)")
 
     ln = sub.add_parser(
         "lint",

@@ -141,6 +141,11 @@ def _emit_record(record: dict, collected: list) -> None:
         )
     collected.append(record)
     print(json.dumps(record), flush=True)
+    # graftledger: data-bench records join the same append-only trajectory
+    # as every other bench stream (obs/ledger.py; never fatal).
+    from distributed_sigmoid_loss_tpu.obs.ledger import append_record
+
+    append_record(record, source="data-bench", problems=problems)
 
 
 def _timed(fn, reps: int) -> float:

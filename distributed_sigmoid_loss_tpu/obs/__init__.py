@@ -18,10 +18,21 @@ evidence attached, chip or no chip:
 - :mod:`.metrics_schema` — the declared registry of every train-metrics and
   serve-stats field, validated at emit by ``MetricsLogger`` and enforced
   statically by graftlint's ``repo-metrics-schema`` rule.
+- :mod:`.ledger` — graftledger: the append-only JSONL perf-trajectory ledger
+  every bench emit path appends to (record + environment fingerprint +
+  explicit status, so a dead backend lands as ``no-backend`` instead of a
+  0.0 "measurement"); summarized/diffed by ``obs ledger`` / ``obs diff``.
+- :mod:`.regress` — chip-free regression gates: the config lattice's proxy
+  metrics (closed-form FLOPs, per-kind wire bytes, mfu_est, loss-island
+  temp bytes) vs committed baselines, run by ``obs regress`` in CI/dryrun.
+- :mod:`.telemetry` — live pull-based metrics: the OpenMetrics-style
+  ``/metrics`` exporter the serving stack mounts, plus the atomic-rename
+  telemetry file the train loop writes under ``--obs-dir``.
 
 Import discipline: this package must stay importable without initializing
 jax (the linter and the CLI's argparse layer import the schema); anything
-jax-touching lives behind function-level imports in :mod:`.attribution`.
+jax-touching lives behind function-level imports in :mod:`.attribution`
+and :mod:`.regress`.
 """
 
 from distributed_sigmoid_loss_tpu.obs.health import (  # noqa: F401
@@ -36,11 +47,26 @@ from distributed_sigmoid_loss_tpu.obs.metrics_schema import (  # noqa: F401
     TRAIN_METRICS_PREFIXES,
     validate_metrics,
 )
+from distributed_sigmoid_loss_tpu.obs.ledger import (  # noqa: F401
+    append_record,
+    backfill_round_files,
+    diff_records,
+    environment_fingerprint,
+    read_ledger,
+    record_status,
+    trajectory,
+    trajectory_summary,
+)
 from distributed_sigmoid_loss_tpu.obs.spans import (  # noqa: F401
     Span,
     SpanRecorder,
     merge_chrome_traces,
     summarize_spans,
+)
+from distributed_sigmoid_loss_tpu.obs.telemetry import (  # noqa: F401
+    TelemetryExporter,
+    render_openmetrics,
+    write_telemetry_file,
 )
 
 __all__ = [
@@ -56,4 +82,15 @@ __all__ = [
     "SERVE_STATS_FIELDS",
     "HEALTH_EVENT_FIELDS",
     "validate_metrics",
+    "append_record",
+    "read_ledger",
+    "record_status",
+    "backfill_round_files",
+    "trajectory",
+    "trajectory_summary",
+    "diff_records",
+    "environment_fingerprint",
+    "TelemetryExporter",
+    "render_openmetrics",
+    "write_telemetry_file",
 ]

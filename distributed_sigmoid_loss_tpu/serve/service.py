@@ -338,6 +338,7 @@ class EmbeddingService:
         self._rejected = 0
         self._timeouts = 0
         self._started = time.monotonic()
+        self._exporter = None  # live /metrics endpoint (start_metrics_server)
 
     # -- engine-facing batch fns (worker thread only) ------------------------
 
@@ -507,6 +508,34 @@ class EmbeddingService:
             snap.update(self.index.stats())
         return snap
 
+    def start_metrics_server(
+        self,
+        port: int = 0,
+        *,
+        host: str = "127.0.0.1",
+        labels: dict | None = None,
+        refresh_s: float = 0.25,
+    ):
+        """Mount the live OpenMetrics-style ``/metrics`` endpoint
+        (obs/telemetry.py): a stdlib HTTP thread serving the :meth:`stats`
+        snapshot as exposition text, with scrape-storm-bounded snapshot
+        reuse. ``labels`` stamps a constant label set onto every series —
+        the per-tenant scoping hook (one exporter per tenant scope).
+        Returns the started :class:`~..obs.telemetry.TelemetryExporter`
+        (``.port`` / ``.url``); :meth:`close` stops it."""
+        from distributed_sigmoid_loss_tpu.obs.telemetry import (
+            TelemetryExporter,
+        )
+
+        if self._exporter is not None:
+            raise RuntimeError("metrics server already started")
+        self._exporter = TelemetryExporter(
+            self.stats, host=host, port=port, labels=labels,
+            refresh_s=refresh_s,
+        )
+        self._exporter.start()
+        return self._exporter
+
     def log_stats(self) -> dict:
         """Emit :meth:`stats` through the wired MetricsLogger (validated
         against the declared serve-stats schema); returns it."""
@@ -522,6 +551,9 @@ class EmbeddingService:
         return snap
 
     def close(self) -> None:
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
         for b in self._batchers.values():
             b.close()
 

@@ -29,6 +29,19 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
+# graftledger isolation: every bench/serve-bench/data-bench emit path appends
+# to the run ledger (obs/ledger.py), which defaults to the COMMITTED
+# LEDGER.jsonl at the repo root — test runs (including the bench.py
+# subprocesses the shield suites spawn, which inherit the env) must land in a
+# scratch file instead of dirtying the real trajectory. Tests that exercise
+# the ledger itself pass explicit paths.
+if "DSL_LEDGER_PATH" not in os.environ:
+    import tempfile
+
+    os.environ["DSL_LEDGER_PATH"] = os.path.join(
+        tempfile.gettempdir(), "dsl_test_ledger.jsonl"
+    )
+
 import jax  # noqa: E402
 
 # The env var alone is not enough: the axon TPU plugin registers itself regardless, so
@@ -55,6 +68,7 @@ _STANDARD_MODULES = {
     "test_data_pipeline",
     "test_distindex",
     "test_distributed_parity",
+    "test_graftledger",
     "test_obs",
     "test_pipeline",
     "test_serve",

@@ -494,6 +494,43 @@ def test_unregistered_bench_record_field_trips():
     ) == []
 
 
+def test_ledger_emit_rule_trips_on_bypass_and_missing_append():
+    """repo-ledger-emit: a record print outside _emit (a path bypassing the
+    ledger) and an _emit without the ledger append both trip; the shipped
+    discipline — every print(json.dumps(...)) inside a ledger-appending
+    _emit — stays green."""
+    good = (
+        "import json\n"
+        "def _emit(record):\n"
+        "    from distributed_sigmoid_loss_tpu.obs.ledger import "
+        "append_record\n"
+        "    print(json.dumps(record))\n"
+        "    append_record(record)\n"
+    )
+    assert repo_lint.check_ledger_emit(good) == []
+    rogue = good + (
+        "def sneaky(record):\n"
+        "    print(json.dumps(record))\n"
+    )
+    findings = repo_lint.check_ledger_emit(rogue)
+    assert _rules_of(findings) == ["repo-ledger-emit"]
+    assert findings[0].subject == "bench.py::sneaky"
+    no_append = (
+        "import json\n"
+        "def _emit(record):\n"
+        "    print(json.dumps(record))\n"
+    )
+    findings = repo_lint.check_ledger_emit(no_append)
+    assert [f.subject for f in findings] == ["bench.py::_emit"]
+    # no _emit at all: the single-emitter contract itself is gone
+    none = repo_lint.check_ledger_emit("x = 1\n")
+    assert [f.subject for f in none] == ["bench.py::_emit"]
+
+
+def test_ledger_emit_green_on_shipped_tree():
+    assert repo_lint.check_ledger_emit() == []
+
+
 # ---------------------------------------------------------------------------
 # bench record schema (shared by bench.py _emit and the lint rule)
 # ---------------------------------------------------------------------------
