@@ -20,14 +20,28 @@ class Finding:
     ``subject``: what was audited — a step-config label for jaxpr rules, a
     ``path::name`` for repo rules.
     ``detail``: human-readable description of the violation and why it bites.
+    ``location``: where to annotate — ``path:line`` for repo rules, a
+    constraint/refusal source for config rules, a step-config label for
+    jaxpr rules. Optional; empty when a rule has no better anchor than
+    ``subject``.
     """
 
     rule: str
     subject: str
     detail: str
+    location: str = ""
 
     def __str__(self) -> str:  # the `lint` CLI's text output line
-        return f"[{self.rule}] {self.subject}: {self.detail}"
+        loc = f" ({self.location})" if self.location else ""
+        return f"[{self.rule}] {self.subject}{loc}: {self.detail}"
 
     def as_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        # CI annotators key on rule_id; keep it alongside the short name so
+        # `lint --json` consumers never parse the text line.
+        d["rule_id"] = self.rule
+        return d
+
+    def key(self) -> tuple[str, str]:
+        """Stable identity used by ``lint --baseline`` suppression."""
+        return (self.rule, self.subject)

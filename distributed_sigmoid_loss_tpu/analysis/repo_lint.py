@@ -109,10 +109,11 @@ MUTABLE_GLOBAL_ALLOWLIST = {
         "a stdlib emit path"
     ),
     "analysis/jaxpr_audit.py::_STEP_CONFIG_CACHE": (
-        "host-side memo of the deterministic fifteen-config trace "
-        "(auditor + obs/attribution + obs/regress share one enumeration; "
-        "the ~22 s trace used to run 3x per tier-1); never read inside "
-        "traced code — it CONTAINS closed jaxprs, which are inert data"
+        "host-side per-label memo of the deterministic step-config traces "
+        "(auditor + obs/attribution + obs/regress share one sampled "
+        "product, and the full-product pass reuses the tier-1 labels; the "
+        "trace used to run 3x per tier-1); never read inside traced code — "
+        "it CONTAINS closed jaxprs, which are inert data"
     ),
 }
 

@@ -1,0 +1,288 @@
+"""graftprove half 2: sharding/state dataflow rules over the traced jaxprs.
+
+Extends jaxpr_audit's ``_Auditor`` invariance walk (per-value ``(inv, red)``
+frozenset pairs: axes a value is replicated over, and the subset it is
+replicated over BECAUSE it was already reduced/gathered) with three rules
+for bug classes the base auditor's communication checks don't see:
+
+- ``jaxpr-redundant-gather``: an ``all_gather`` whose operand is already
+  known-invariant (replicated) over every gathered axis — W identical copies
+  concatenated, pure wire + HBM waste. Scoped to gathers on purpose: a
+  ``psum`` of a replicated-but-not-reduced value is jax's own sanctioned
+  psum-self-transpose convention (the pmean backward, compensated by 1/S)
+  and must stay silent, and a psum of an already-REDUCED value is already
+  ``jaxpr-double-psum``. Unknown ⇒ varying ⇒ silent, the base walk's
+  no-false-positive direction.
+- ``jaxpr-state-drop``: a ``scan`` carry that the body READS and UPDATES
+  with data from outside the carry, whose final value then never leaves the
+  scan — state the program pretends to maintain but actually discards (the
+  historical pp-silently-dropped-quant bug; the class the compression
+  stream's error-feedback residual lives in). Pure carry rotations
+  (``ppermute`` of the carry itself, counters ``c+1``) are exempt: their
+  update depends on nothing outside the carry, so dropping the final value
+  loses no information that entered the loop. GPipe's drained shift
+  registers (parallel/pipeline.py) are updated WITH external microbatch data
+  by design and legitimately drained — pp step configs opt out via
+  ``check_state_drop=False``, same per-config-kwarg pattern as
+  ``expect_chunk_checkpoint``.
+- ``jaxpr-collective-order``: across ``cond`` branches, the per-axis
+  sequence of collectives must match whenever the predicate is not
+  known-invariant over that axis — shards disagreeing on the branch would
+  enter different collective sequences and deadlock the mesh (the multihost
+  hang class).
+
+Run alongside the base audit by ``audit_default_step_configs`` for every
+config in the sampled product; rule catalog in docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from distributed_sigmoid_loss_tpu.analysis.findings import Finding
+from distributed_sigmoid_loss_tpu.analysis.jaxpr_audit import (
+    _ALL_COLLECTIVES,
+    _GATHER_PRIMS,
+    _Auditor,
+    _collective_axes,
+    _is_literal,
+    _jaxpr_of,
+    _sub_jaxprs,
+)
+
+__all__ = ["SHARD_FLOW_RULES", "audit_shard_flow"]
+
+SHARD_FLOW_RULES = (
+    "jaxpr-redundant-gather",
+    "jaxpr-state-drop",
+    "jaxpr-collective-order",
+)
+
+# Collectives that synchronize across shards of an axis — the ones whose
+# cross-branch ordering matters for the deadlock check. axis_index is pure
+# (no communication) and ppermute of nothing deadlocks nothing by itself,
+# but a mismatched ppermute still leaves peers waiting, so everything but
+# axis_index counts.
+_SYNC_COLLECTIVES = _ALL_COLLECTIVES - {"axis_index"}
+
+
+def _collective_sequence(jaxpr, out: list) -> None:
+    """Flat (prim_name, axes) sequence of every named-axis collective under
+    ``jaxpr``, in program order, recursing through call-like/scan/shard_map
+    sub-jaxprs (a collective inside a scan body synchronizes every
+    iteration; for cross-branch comparison its one-body order is what must
+    agree)."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _SYNC_COLLECTIVES:
+            axes = _collective_axes(eqn)
+            if axes:
+                out.append((name, axes))
+        for _, inner in _sub_jaxprs(eqn.params):
+            _collective_sequence(inner, out)
+
+
+class _FlowAuditor(_Auditor):
+    """The base invariance walk plus the redundant-gather and
+    collective-order emissions (state-drop is a separate structural pass —
+    it needs liveness, not invariance)."""
+
+    def _walk_collective(self, eqn, env, bound, emit, get) -> None:
+        name = eqn.primitive.name
+        if name in _GATHER_PRIMS and emit:
+            axes = _collective_axes(eqn)
+            v = eqn.invars[0]
+            # Scalars exempt: a gathered scalar is bookkeeping wire (the
+            # compressed hop's quant-scale exchange double-syncs the two
+            # scalar params whose grads the loss island already psum'd over
+            # dcn — 4 bytes, uniform-tree compression by design), not the
+            # W-identical-HBM-blocks waste this rule exists for.
+            if (
+                axes
+                and not _is_literal(v)
+                and getattr(getattr(v, "aval", None), "size", 1) > 1
+            ):
+                inv = get(v)[0]
+                covered = sorted(ax for ax in axes if ax in inv)
+                if len(covered) == len(axes):
+                    self.add(
+                        "jaxpr-redundant-gather",
+                        f"{name} over axis(es) {covered} of a value already "
+                        "replicated over them — every shard contributes an "
+                        "identical copy, so the gather is W identical "
+                        "blocks of wire traffic and HBM for data each "
+                        "shard already holds; drop the gather (or shard "
+                        "the producer)",
+                    )
+        super()._walk_collective(eqn, env, bound, emit, get)
+
+    def _walk_cond(self, eqn, env, bound, emit, get) -> None:
+        if emit:
+            branches = eqn.params.get("branches", ())
+            seqs = []
+            for br in branches:
+                inner = _jaxpr_of(br)
+                seq: list = []
+                if inner is not None:
+                    _collective_sequence(inner, seq)
+                seqs.append(tuple(seq))
+            pred_inv = get(eqn.invars[0])[0] if eqn.invars else frozenset()
+            axes_seen = sorted(
+                {ax for seq in seqs for _, axes in seq for ax in axes}
+            )
+            for ax in axes_seen:
+                if ax in pred_inv:
+                    # Every shard of ax agrees on the predicate, so they all
+                    # take the same branch — differing sequences can't split
+                    # the axis.
+                    continue
+                if ax not in bound:
+                    continue  # foreign axis: jaxpr-collective-axis's beat
+                per_branch = [
+                    tuple((n, axes) for n, axes in seq if ax in axes)
+                    for seq in seqs
+                ]
+                if len(set(per_branch)) > 1:
+                    shapes = ", ".join(
+                        "[" + " ".join(n for n, _ in pb) + "]"
+                        for pb in per_branch
+                    )
+                    self.add(
+                        "jaxpr-collective-order",
+                        f"cond branches run different collective sequences "
+                        f"over axis {ax!r} ({shapes}) and the predicate is "
+                        "not known replicated over it — shards that "
+                        "disagree on the branch enter mismatched "
+                        "collectives and the mesh deadlocks (multihost "
+                        "hang class); hoist the collectives out of the "
+                        "cond or make the predicate axis-invariant",
+                    )
+        super()._walk_cond(eqn, env, bound, emit, get)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-state-drop: a structural liveness pass, independent of invariance.
+
+
+def _external_deps(body, var, carry_invars: set) -> bool:
+    """Does ``var``'s transitive definition inside ``body`` draw on anything
+    beyond the carry invars (consts, xs slices, constvars)? False for pure
+    carry rotations/counters — the exempt class."""
+    produced_by: dict = {}
+    for eqn in body.eqns:
+        for ov in eqn.outvars:
+            produced_by[ov] = eqn
+    seen: set = set()
+    stack = [var]
+    while stack:
+        v = stack.pop()
+        if _is_literal(v) or v in seen:
+            continue
+        seen.add(v)
+        eqn = produced_by.get(v)
+        if eqn is None:
+            # A leaf: a body invar or constvar. External unless it is one of
+            # the carry's own invars.
+            if v not in carry_invars:
+                return True
+            continue
+        stack.extend(eqn.invars)
+        # Sub-jaxpr closures (scan/cond/pjit bodies) see only their mapped
+        # operands, which are already in eqn.invars; constvars of the OUTER
+        # body reached through them are leaves handled above.
+    return False
+
+
+def _live_vars(jaxpr) -> set:
+    live = set(v for v in jaxpr.outvars if not _is_literal(v))
+    for eqn in jaxpr.eqns:
+        live.update(v for v in eqn.invars if not _is_literal(v))
+    return live
+
+
+def _is_drop_var(v) -> bool:
+    return type(v).__name__ == "DropVar"
+
+
+def _check_state_drops(jaxpr, add) -> None:
+    """Recursively flag scan carries that are read, updated with external
+    data, and whose final value is dead at the scan's own level."""
+    live = _live_vars(jaxpr)
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            body = _jaxpr_of(eqn.params.get("jaxpr"))
+            if body is not None and not any(
+                beqn.primitive.name == "add_any" for beqn in body.eqns
+            ):
+                # add_any is a transpose-only primitive: a scan body holding
+                # one is AD-generated cotangent accumulation (the reversed
+                # scan legitimately drops the cotangent of a constant carry
+                # init), not user state — only forward-authored scans are in
+                # scope for the drop check.
+                nc = eqn.params.get("num_consts", 0)
+                ncar = eqn.params.get("num_carry", 0)
+                carry_invars = set(body.invars[nc : nc + ncar])
+                reads: set = set()
+                for beqn in body.eqns:
+                    reads.update(
+                        v for v in beqn.invars
+                        if not _is_literal(v) and v in carry_invars
+                    )
+                # A carry passed through to a ys output is also a read.
+                for ov in body.outvars[ncar:]:
+                    if not _is_literal(ov) and ov in carry_invars:
+                        reads.add(ov)
+                for i in range(min(ncar, len(eqn.outvars))):
+                    ci = body.invars[nc + i]
+                    co = body.outvars[i]
+                    scan_out = eqn.outvars[i]
+                    if ci not in reads:
+                        continue  # write-only slot; not "read then dropped"
+                    if co is ci or _is_literal(co):
+                        continue  # passthrough / constant: nothing updated
+                    if not (_is_drop_var(scan_out) or scan_out not in live):
+                        continue  # the final value IS consumed
+                    if not _external_deps(body, co, carry_invars):
+                        # Pure rotation/counter (ring ppermute buffers,
+                        # c + 1): dropping it loses nothing that entered
+                        # the loop.
+                        continue
+                    aval = getattr(ci, "aval", None)
+                    add(
+                        "jaxpr-state-drop",
+                        f"scan carry #{i} ({aval}) is read by the body and "
+                        "updated with non-carry data, but the updated value "
+                        "never leaves the scan — state the program "
+                        "maintains and then silently discards (the "
+                        "pp-dropped-quant / error-feedback-residual "
+                        "class); thread the final carry to an output or "
+                        "stop carrying it",
+                    )
+        for _, inner in _sub_jaxprs(eqn.params):
+            _check_state_drops(inner, add)
+
+
+def audit_shard_flow(
+    jaxpr_or_closed,
+    *,
+    label: str,
+    bound_axes: dict | None = None,
+    check_state_drop: bool = True,
+) -> list[Finding]:
+    """Run the three shard-flow rules over one (closed) jaxpr.
+
+    ``check_state_drop=False`` is the pp opt-out: GPipe's shift-register
+    carries are drained by design (see module docstring).
+    """
+    j = _jaxpr_of(jaxpr_or_closed)
+    if j is None:
+        raise TypeError(f"not a jaxpr: {jaxpr_or_closed!r}")
+    auditor = _FlowAuditor(label)
+    bound = dict(bound_axes or {})
+    env: dict = {}
+    for iv in j.invars:
+        env[iv] = (frozenset(), frozenset())
+    for cv in getattr(j, "constvars", ()):
+        env[cv] = (frozenset(bound), frozenset())
+    auditor.walk(j, env, bound, True)
+    if check_state_drop:
+        _check_state_drops(j, auditor.add)
+    return [f for f in auditor.findings if f.rule in SHARD_FLOW_RULES]

@@ -25,11 +25,13 @@ The subcommands tie the subsystems together:
   as schema-validated JSON records with the ``synthetic_ratio`` acceptance
   figure and a decode worker-scaling curve. CPU-runnable —
   docs/PERF.md "Feeding the headline".
-- ``lint`` — graftlint: the repo-invariant AST linter plus the jaxpr
-  collective/dtype auditor traced over the fifteen real step configs on an
-  emulated CPU mesh (exit 1 on findings, ``--json``, per-rule ``--disable``).
-  The same analyzers run in tier-1 (tests/test_analysis.py) and the dryrun —
-  docs/ANALYSIS.md.
+- ``lint`` — graftlint: the repo-invariant AST linter, the graftprove
+  config-space drift check (declarative solver vs the real imperative
+  refusals), and the jaxpr collective/dtype/dataflow auditor traced over the
+  sampled step-config product on an emulated CPU mesh (exit 1 on findings,
+  ``--json``, per-rule ``--disable``, ``--full-product``, ``--baseline``).
+  The same analyzers run in tier-1 (tests/test_analysis.py,
+  tests/test_config_space.py) and the dryrun — docs/ANALYSIS.md.
 - ``obs`` — graftscope offline reports: ``obs summarize DIR`` merges the
   host spans a ``train --obs-dir`` run recorded with any device trace
   capture under DIR into one where-the-time-goes report, optionally writing
@@ -340,6 +342,112 @@ def _eval_holdout_source(args, cfg, tokenize, native_decode: bool):
     raise SystemExit(2)
 
 
+def _train_config_conflicts(args) -> str | None:
+    """The ``train`` command's config-compatibility refusals, as a pure
+    predicate: the first conflict message, or None when the flag set is
+    coherent.
+
+    Extracted from cmd_train so graftprove (analysis/config_space.py) can
+    probe the CLI layer with a synthesized namespace: every refusal here is
+    config-space (flag compatibility) and must agree with the declarative
+    constraint table — a disagreement is a ``config-space-drift`` finding.
+    Environment checks (paths, coordinators, device counts) stay in
+    cmd_train.
+    """
+    if args.ep < 1:
+        return f"--ep must be >= 1, got {args.ep}"
+    if args.moe_aux_weight is not None and not args.moe_experts:
+        return ("--moe-aux-weight without --moe-experts would be a silent "
+                "no-op (a dense model has no routers to balance)")
+    if args.pp > 1 and args.moe_experts:
+        return "--pp with --moe-experts is not supported (pp towers are dense)"
+    if args.pp > 1 and args.zero1:
+        return ("--pp with --zero1 is not supported (ZeRO-1 would re-shard "
+                "the stage-local moments dp-wise every step)")
+    if args.pp_microbatches and args.pp <= 1:
+        return "--pp-microbatches without --pp > 1 would be a silent no-op"
+    if args.pp_microbatches < 0:
+        return f"--pp-microbatches must be >= 1, got {args.pp_microbatches}"
+    if args.accum_bf16 and args.accum == 1:
+        # Same check exists in make_train_step; exit-2 here beats a deep raise.
+        return ("--accum-bf16 requires --accum > 1 (the unaccumulated step "
+                "has no accumulator)")
+    if args.pp > 1 and args.accum > 1 and args.accum_negatives == "global":
+        # Same check exists in make_train_step; repeat it HERE so the exit-2
+        # message lands before the minutes-long create_train_state.
+        return ("--accum-negatives global with --pp is not supported (the pp "
+                "forward is already whole-batch per accumulation step)")
+    if args.gradcache_bf16 and (
+        args.accum == 1 or args.accum_negatives != "global"
+    ):
+        return ("--gradcache-bf16 requires --accum > 1 with "
+                "--accum-negatives global (only the GradCache path stashes "
+                "embedding tables)")
+    if args.loss_impl == "chunked":
+        # Refuse, don't drop: a run claiming the streamed-negatives memory
+        # shape while silently running the ring would invalidate any HBM A/B.
+        if args.variant == "ring":
+            return ("--loss-impl chunked applies to the all_gather variant "
+                    "only (the ring already streams negatives one chunk per "
+                    "hop); drop --variant ring or pass --variant all_gather")
+        if args.ring_overlap:
+            return ("--loss-impl chunked (all_gather) and --ring-overlap "
+                    "(ring) select different comm variants; pick one")
+    if args.ring_overlap and args.variant == "all_gather":
+        return ("--ring-overlap applies to the ring variant only (the "
+                "all-gather loss has no hop loop to overlap)")
+    if args.loss_family == "softmax" and (
+        args.loss_impl != "fused" or args.ring_overlap
+    ):
+        return ("--loss-impl chunked / --ring-overlap apply to the sigmoid "
+                "family only (the softmax ring already streams its logsumexp)")
+    if args.use_pallas and args.loss_family != "sigmoid":
+        # The streaming kernel computes the sigmoid family's block math; a
+        # softmax run claiming --use-pallas would silently run plain XLA.
+        return "--use-pallas applies to the sigmoid family only"
+    if args.watchdog == "skip" and not args.ckpt_dir:
+        # The jitted step DONATES its input state, so a poisoned update can
+        # only be undone by restoring a checkpoint — skip without --ckpt-dir
+        # would silently train on from the poisoned params.
+        return ("--watchdog skip requires --ckpt-dir (skipping rolls back to "
+                "the last good checkpoint; without one there is nothing to "
+                "roll back to)")
+    if args.dcn_slices > 1 and not args.grad_compression:
+        return ("--dcn-slices without --grad-compression is a silent no-op: "
+                "the regular step already spans slices when the dp axis is "
+                "built dcn-outermost (parallel/multihost.py make_hybrid_mesh); "
+                "the separate dcn axis exists to compress its gradient hop")
+    if args.grad_compression:
+        reasons = []
+        if args.dcn_slices < 2:
+            reasons.append("--dcn-slices >= 2 (the dcn axis being compressed)")
+        if args.variant == "ring":
+            reasons.append("--variant all_gather or unset (ring ppermute has "
+                           "no joint-(dcn,dp) axis form)")
+        if args.ep > 1:
+            # --pp and --moe-experts (experts replicated, ep == 1) compose
+            # since round 5; expert PARALLELISM stays with the regular step
+            # (no GSPMD all-to-alls inside the manual region).
+            reasons.append("no --ep (expert parallelism needs the regular step)")
+        if args.ring_overlap:
+            reasons.append("no --ring-overlap (compressed sync is "
+                           "all_gather-only; there is no ring hop loop)")
+        if args.ema_decay is not None:
+            reasons.append("no --ema-decay")
+        if args.grad_compression == "topk" and not (0 < args.topk_frac <= 1):
+            reasons.append(
+                f"--topk-frac in (0, 1], got {args.topk_frac} (it is the "
+                f"fraction of gradient entries kept per tensor)"
+            )
+        if reasons:
+            return "--grad-compression requires: " + "; ".join(reasons)
+    if args.topk_frac != 0.01 and args.grad_compression != "topk":
+        return "--topk-frac without --grad-compression topk is a silent no-op"
+    if args.topk_exact and args.grad_compression != "topk":
+        return "--topk-exact without --grad-compression topk is a silent no-op"
+    return None
+
+
 def cmd_train(args) -> int:
     _bootstrap_devices(args)
     import jax
@@ -411,129 +519,9 @@ def cmd_train(args) -> int:
     from distributed_sigmoid_loss_tpu.utils.logging import MetricsLogger
 
     cfg = _model_config(args)
-    if args.ep < 1:
-        print(f"--ep must be >= 1, got {args.ep}", file=sys.stderr)
-        return 2
-    if args.moe_aux_weight is not None and not args.moe_experts:
-        print(
-            "--moe-aux-weight without --moe-experts would be a silent no-op "
-            "(a dense model has no routers to balance)",
-            file=sys.stderr,
-        )
-        return 2
-    if args.pp > 1 and args.moe_experts:
-        print("--pp with --moe-experts is not supported (pp towers are dense)",
-              file=sys.stderr)
-        return 2
-    if args.pp > 1 and args.zero1:
-        print("--pp with --zero1 is not supported (ZeRO-1 would re-shard the "
-              "stage-local moments dp-wise every step)", file=sys.stderr)
-        return 2
-    if args.pp_microbatches and args.pp <= 1:
-        print("--pp-microbatches without --pp > 1 would be a silent no-op",
-              file=sys.stderr)
-        return 2
-    if args.pp_microbatches < 0:
-        print(f"--pp-microbatches must be >= 1, got {args.pp_microbatches}",
-              file=sys.stderr)
-        return 2
-    if args.accum_bf16 and args.accum == 1:
-        # Same check exists in make_train_step; exit-2 here beats a deep raise.
-        print("--accum-bf16 requires --accum > 1 (the unaccumulated step has "
-              "no accumulator)", file=sys.stderr)
-        return 2
-    if args.pp > 1 and args.accum > 1 and args.accum_negatives == "global":
-        # Same check exists in make_train_step; repeat it HERE so the exit-2
-        # message lands before the minutes-long create_train_state.
-        print("--accum-negatives global with --pp is not supported (the pp "
-              "forward is already whole-batch per accumulation step)",
-              file=sys.stderr)
-        return 2
-    if args.gradcache_bf16 and (
-        args.accum == 1 or args.accum_negatives != "global"
-    ):
-        print("--gradcache-bf16 requires --accum > 1 with --accum-negatives "
-              "global (only the GradCache path stashes embedding tables)",
-              file=sys.stderr)
-        return 2
-    if args.loss_impl == "chunked":
-        # Refuse, don't drop: a run claiming the streamed-negatives memory
-        # shape while silently running the ring would invalidate any HBM A/B.
-        if args.variant == "ring":
-            print("--loss-impl chunked applies to the all_gather variant only "
-                  "(the ring already streams negatives one chunk per hop); "
-                  "drop --variant ring or pass --variant all_gather",
-                  file=sys.stderr)
-            return 2
-        if args.ring_overlap:
-            print("--loss-impl chunked (all_gather) and --ring-overlap (ring) "
-                  "select different comm variants; pick one", file=sys.stderr)
-            return 2
-    if args.ring_overlap and args.variant == "all_gather":
-        print("--ring-overlap applies to the ring variant only (the "
-              "all-gather loss has no hop loop to overlap)", file=sys.stderr)
-        return 2
-    if args.loss_family == "softmax" and (
-        args.loss_impl != "fused" or args.ring_overlap
-    ):
-        print("--loss-impl chunked / --ring-overlap apply to the sigmoid "
-              "family only (the softmax ring already streams its logsumexp)",
-              file=sys.stderr)
-        return 2
-    if args.use_pallas and args.loss_family != "sigmoid":
-        # The streaming kernel computes the sigmoid family's block math; a
-        # softmax run claiming --use-pallas would silently run plain XLA.
-        print("--use-pallas applies to the sigmoid family only",
-              file=sys.stderr)
-        return 2
-    if args.watchdog == "skip" and not args.ckpt_dir:
-        # The jitted step DONATES its input state, so a poisoned update can
-        # only be undone by restoring a checkpoint — skip without --ckpt-dir
-        # would silently train on from the poisoned params.
-        print("--watchdog skip requires --ckpt-dir (skipping rolls back to "
-              "the last good checkpoint; without one there is nothing to "
-              "roll back to)", file=sys.stderr)
-        return 2
-    if args.dcn_slices > 1 and not args.grad_compression:
-        print("--dcn-slices without --grad-compression is a silent no-op: the "
-              "regular step already spans slices when the dp axis is built "
-              "dcn-outermost (parallel/multihost.py make_hybrid_mesh); the "
-              "separate dcn axis exists to compress its gradient hop",
-              file=sys.stderr)
-        return 2
-    if args.grad_compression:
-        reasons = []
-        if args.dcn_slices < 2:
-            reasons.append("--dcn-slices >= 2 (the dcn axis being compressed)")
-        if args.variant == "ring":
-            reasons.append("--variant all_gather or unset (ring ppermute has "
-                           "no joint-(dcn,dp) axis form)")
-        if args.ep > 1:
-            # --pp and --moe-experts (experts replicated, ep == 1) compose
-            # since round 5; expert PARALLELISM stays with the regular step
-            # (no GSPMD all-to-alls inside the manual region).
-            reasons.append("no --ep (expert parallelism needs the regular step)")
-        if args.ring_overlap:
-            reasons.append("no --ring-overlap (compressed sync is "
-                           "all_gather-only; there is no ring hop loop)")
-        if args.ema_decay is not None:
-            reasons.append("no --ema-decay")
-        if args.grad_compression == "topk" and not (0 < args.topk_frac <= 1):
-            reasons.append(
-                f"--topk-frac in (0, 1], got {args.topk_frac} (it is the "
-                f"fraction of gradient entries kept per tensor)"
-            )
-        if reasons:
-            print("--grad-compression requires: " + "; ".join(reasons),
-                  file=sys.stderr)
-            return 2
-    if args.topk_frac != 0.01 and args.grad_compression != "topk":
-        print("--topk-frac without --grad-compression topk is a silent "
-              "no-op", file=sys.stderr)
-        return 2
-    if args.topk_exact and args.grad_compression != "topk":
-        print("--topk-exact without --grad-compression topk is a silent "
-              "no-op", file=sys.stderr)
+    conflict = _train_config_conflicts(args)
+    if conflict is not None:
+        print(conflict, file=sys.stderr)
         return 2
     mesh, mesh_err = _make_training_mesh(args)
     if mesh_err:
@@ -1735,6 +1723,49 @@ def _load_host_spans(root: str):
     return host_trace, host_paths, spans
 
 
+def _add_obs_args(p) -> None:
+    """Register the `obs` arguments on ``p`` — used for both the subparser in
+    ``main`` (so `obs` shows up in --help) and the standalone intermixed
+    parser the obs short-circuit builds, keeping the two in lockstep."""
+    p.add_argument("action",
+                   choices=["summarize", "ledger", "diff", "regress"],
+                   help="summarize: aggregate host spans + device op time "
+                        "under DIR; ledger: per-metric trajectory summary; "
+                        "diff: field-level diff of two records or two run "
+                        "dirs' span summaries; regress: proxy metrics vs "
+                        "the committed baseline (exit 1 on regression)")
+    p.add_argument("paths", nargs="*",
+                   help="summarize: DIR; diff: two operands (metric@N "
+                        "ledger selector, entry index, record-JSON path, "
+                        "or run dir); ledger/regress: none")
+    p.add_argument("--top", type=int, default=12,
+                   help="rows per device-op table (obs summarize)")
+    p.add_argument("--merged-out", default="", metavar="PATH",
+                   help="also write one merged Chrome-trace JSON (host + "
+                        "device events; open in ui.perfetto.dev)")
+    p.add_argument("--ledger", default="", metavar="PATH",
+                   help="ledger file for `obs ledger`/`obs diff` (default: "
+                        "DSL_LEDGER_PATH or LEDGER.jsonl at the repo root)")
+    p.add_argument("--metric", default="", metavar="NAME",
+                   help="restrict `obs ledger` to one metric stream")
+    p.add_argument("--backfill", action="store_true",
+                   help="before summarizing, seed the ledger from the "
+                        "committed BENCH_r*/MULTICHIP_r* round files "
+                        "(idempotent; rounds whose backend was down land "
+                        "as status=no-backend)")
+    p.add_argument("--baseline", default="", metavar="PATH",
+                   help="`obs regress`: baseline file (default: the "
+                        "committed obs/regress_baseline.json)")
+    p.add_argument("--update", action="store_true",
+                   help="`obs regress`: regenerate the baseline from the "
+                        "current tree instead of comparing (commit the "
+                        "result with the change that moved it)")
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="`obs regress`: virtual CPU mesh size (default 8 — "
+                        "the same deterministic mesh the committed "
+                        "baseline was generated on)")
+
+
 def cmd_obs(args) -> int:
     """The graftscope/graftledger offline surface:
 
@@ -2019,9 +2050,10 @@ def _obs_summarize(args) -> int:
 
 
 def cmd_lint(args) -> int:
-    """Run graftlint: the repo-invariant AST linter plus (default) the jaxpr
-    collective/dtype auditor over the fifteen real step configs on an emulated
-    CPU mesh. Exit 0 = clean, 1 = findings, 2 = usage error.
+    """Run graftlint: the repo-invariant AST linter plus (default) the
+    config-space drift check and the jaxpr collective/dtype/dataflow auditor
+    over the sampled step-config product on an emulated CPU mesh. Exit 0 =
+    clean, 1 = findings, 2 = usage error.
 
     Rule catalog + allowlist policy: docs/ANALYSIS.md. The same entry points
     run inside tests/test_analysis.py and the __graft_entry__ dryrun, so a
@@ -2034,7 +2066,12 @@ def cmd_lint(args) -> int:
     _bootstrap_devices(args)
     import json as jsonmod
 
-    from distributed_sigmoid_loss_tpu.analysis import ALL_RULES, run_lint
+    from distributed_sigmoid_loss_tpu.analysis import (
+        ALL_RULES,
+        apply_lint_baseline,
+        load_lint_baseline,
+        run_lint,
+    )
 
     unknown = [r for r in args.disable if r not in ALL_RULES]
     if unknown:
@@ -2044,10 +2081,28 @@ def cmd_lint(args) -> int:
             file=sys.stderr,
         )
         return 2
-    findings = run_lint(disabled=set(args.disable), jaxpr=not args.no_jaxpr)
+    baseline_keys = None
+    if args.baseline:
+        try:
+            baseline_keys = load_lint_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"--baseline: {e}", file=sys.stderr)
+            return 2
+    findings = run_lint(
+        disabled=set(args.disable),
+        jaxpr=not args.no_jaxpr,
+        full_product=args.full_product,
+    )
+    if baseline_keys is not None:
+        findings = apply_lint_baseline(findings, baseline_keys)
     checked = [r for r in ALL_RULES if r not in args.disable]
     if args.no_jaxpr:
-        checked = [r for r in checked if not r.startswith("jaxpr-")]
+        checked = [
+            r for r in checked
+            if not r.startswith("jaxpr-") and r != "config-space-drift"
+        ]
+    if baseline_keys is None:
+        checked = [r for r in checked if r != "lint-stale-suppression"]
     if args.json:
         print(jsonmod.dumps({
             "rules_checked": checked,
@@ -2485,60 +2540,37 @@ def main(argv=None) -> int:
              "diffs), `obs regress` (chip-free proxy regression gate vs the "
              "committed baseline) — docs/OBSERVABILITY.md",
     )
-    ob.add_argument("action",
-                    choices=["summarize", "ledger", "diff", "regress"],
-                    help="summarize: aggregate host spans + device op time "
-                         "under DIR; ledger: per-metric trajectory summary; "
-                         "diff: field-level diff of two records or two run "
-                         "dirs' span summaries; regress: proxy metrics vs "
-                         "the committed baseline (exit 1 on regression)")
-    ob.add_argument("paths", nargs="*",
-                    help="summarize: DIR; diff: two operands (metric@N "
-                         "ledger selector, entry index, record-JSON path, "
-                         "or run dir); ledger/regress: none")
-    ob.add_argument("--top", type=int, default=12,
-                    help="rows per device-op table (obs summarize)")
-    ob.add_argument("--merged-out", default="", metavar="PATH",
-                    help="also write one merged Chrome-trace JSON (host + "
-                         "device events; open in ui.perfetto.dev)")
-    ob.add_argument("--ledger", default="", metavar="PATH",
-                    help="ledger file for `obs ledger`/`obs diff` (default: "
-                         "DSL_LEDGER_PATH or LEDGER.jsonl at the repo root)")
-    ob.add_argument("--metric", default="", metavar="NAME",
-                    help="restrict `obs ledger` to one metric stream")
-    ob.add_argument("--backfill", action="store_true",
-                    help="before summarizing, seed the ledger from the "
-                         "committed BENCH_r*/MULTICHIP_r* round files "
-                         "(idempotent; rounds whose backend was down land "
-                         "as status=no-backend)")
-    ob.add_argument("--baseline", default="", metavar="PATH",
-                    help="`obs regress`: baseline file (default: the "
-                         "committed obs/regress_baseline.json)")
-    ob.add_argument("--update", action="store_true",
-                    help="`obs regress`: regenerate the baseline from the "
-                         "current tree instead of comparing (commit the "
-                         "result with the change that moved it)")
-    ob.add_argument("--cpu-devices", type=int, default=0,
-                    help="`obs regress`: virtual CPU mesh size (default 8 — "
-                         "the same deterministic mesh the committed "
-                         "baseline was generated on)")
+    _add_obs_args(ob)
 
     ln = sub.add_parser(
         "lint",
-        help="graftlint: repo-invariant linter + jaxpr collective/dtype "
-             "auditor over the fifteen step configs (exit 1 on findings); "
-             "rule catalog in docs/ANALYSIS.md",
+        help="graftlint: repo-invariant linter + config-space drift check + "
+             "jaxpr collective/dtype/dataflow auditor over the sampled "
+             "step-config product (exit 1 on findings); rule catalog in "
+             "docs/ANALYSIS.md",
     )
     ln.add_argument("--json", action="store_true",
-                    help="machine-readable report (rules checked + findings) "
-                         "instead of one text line per finding")
+                    help="machine-readable report (rules checked + findings, "
+                         "each with a stable rule_id + location) instead of "
+                         "one text line per finding")
     ln.add_argument("--disable", action="append", default=[], metavar="RULE",
                     help="skip this rule id (repeatable); see docs/ANALYSIS.md "
                          "for the catalog — prefer fixing or allowlisting "
                          "with a rationale over disabling")
     ln.add_argument("--no-jaxpr", action="store_true",
-                    help="AST rules only (skip tracing the fifteen step configs; "
-                         "sub-second, for pre-commit-style hooks)")
+                    help="AST rules only (skip the config-space probe and "
+                         "the step-config traces; sub-second, for "
+                         "pre-commit-style hooks)")
+    ln.add_argument("--full-product", action="store_true",
+                    help="audit the pairwise-covering sample of the FULL "
+                         "legal config product from the solver, not just "
+                         "the tier-1 sample (~30 s of extra traces; what "
+                         "the dryrun's graftprove token runs)")
+    ln.add_argument("--baseline", default="", metavar="FILE",
+                    help="ratchet mode: suppress findings recorded in FILE "
+                         "(a saved `lint --json` report or a JSON list of "
+                         "{rule, subject}); entries that no longer fire "
+                         "become lint-stale-suppression findings")
     ln.add_argument("--cpu-devices", type=int, default=0,
                     help="virtual CPU mesh size for the jaxpr auditor "
                          "(default 8 — the same emulated mesh the tests use)")
@@ -2550,6 +2582,19 @@ def main(argv=None) -> int:
     # fallback if this short-circuit is ever bypassed.
     if argv[:1] == ["bench"]:
         return cmd_bench(argv[1:])
+    # obs mixes nargs="*" positionals (diff's two operands) with options;
+    # plain parse_args consumes positionals greedily, so flags were only
+    # accepted trailing (`obs diff A B --ledger P` worked, `obs diff
+    # --ledger P A B` errored). parse_intermixed_args fixes that but cannot
+    # traverse subparsers, so obs is routed through a standalone parser
+    # built from the same _add_obs_args. The subparser stays registered for
+    # --help and as a fallback.
+    if argv[:1] == ["obs"]:
+        obs_ap = argparse.ArgumentParser(
+            prog="distributed_sigmoid_loss_tpu obs"
+        )
+        _add_obs_args(obs_ap)
+        return cmd_obs(obs_ap.parse_intermixed_args(argv[1:]))
     args = ap.parse_args(argv)
     dispatch = {
         "train": cmd_train,

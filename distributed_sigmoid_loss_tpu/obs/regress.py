@@ -8,7 +8,9 @@ collective wire bytes WITHOUT a TPU in the loop. ``obs regress`` turns them
 into a CI gate on CPU:
 
 - **Step-config lattice** (trace-only, seconds): every config in graftlint's
-  fifteen-config enumeration (``analysis/jaxpr_audit.step_config_jaxprs``)
+  sampled step-config product (``analysis/jaxpr_audit.step_config_jaxprs``,
+  drawn from the ``analysis/config_space`` solver's legal product — the
+  fifteen legacy configs plus the coverage extras)
   gets its ``obs/attribution`` proxies — closed-form FLOPs, per-kind
   collective wire bytes, and the roofline ``mfu_est`` ceiling — compared
   against the committed baseline with noise-aware tolerances (closed-form

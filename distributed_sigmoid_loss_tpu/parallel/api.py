@@ -55,6 +55,13 @@ def make_per_shard_loss(
     rather than silently no-op — a record or run claiming a memory/overlap
     recipe that never executed is the config drift these checks exist to
     prevent.
+
+    Each refusal below is mirrored by a named constraint in
+    ``analysis/config_space.CONSTRAINTS`` (``chunked-needs-allgather``,
+    ``overlap-needs-ring``, ``softmax-fused-only``, ``pallas-sigmoid-only``,
+    …) and the lint drift probe calls this function for every point of the
+    raw config product — add/remove a refusal here without updating the
+    table and ``lint`` fails with ``config-space-drift``.
     """
     if family not in ("sigmoid", "softmax"):
         raise ValueError(f"unknown family: {family!r}")

@@ -114,6 +114,84 @@ def with_error_feedback(
     return state.replace(ef=ef)
 
 
+def validate_compressed_step_args(
+    *,
+    accum_steps: int,
+    accum_dtype: str | None,
+    accum_negatives: str,
+    pp_microbatches: int,
+    zero1: bool,
+    moe_aux_weight: float | None,
+    gradcache_embed_dtype: str | None,
+    compression: str,
+    error_feedback: bool,
+    topk_frac: float,
+    loss_variant: str,
+    mesh_axis_names: tuple = ("dcn", "dp"),
+):
+    """Pure config-compatibility refusals for
+    :func:`make_compressed_train_step`, returning ``(cached_accum, acc_dt)``.
+
+    Config-space only, same split as train_step.validate_step_args: the
+    graftprove probe (analysis/config_space.py) calls this with a superset
+    ``mesh_axis_names`` so it exercises exactly the refusals the declarative
+    table must mirror; environment checks (tower shapes, quant mode of the
+    actual model) stay in the builder.
+    """
+    acc_dt = validate_accum_args(accum_steps, accum_dtype)
+    if accum_negatives not in ("local", "global"):
+        raise ValueError(
+            f"accum_negatives must be 'local' or 'global', got {accum_negatives!r}"
+        )
+    cached_accum = accum_negatives == "global" and accum_steps > 1
+    if gradcache_embed_dtype is not None and not cached_accum:
+        raise ValueError(
+            f"gradcache_embed_dtype={gradcache_embed_dtype!r} requires "
+            "accum_negatives='global' with accum_steps > 1 (only the "
+            "GradCache path stashes embedding tables)"
+        )
+    if pp_microbatches < 0:
+        raise ValueError(f"pp_microbatches must be >= 0, got {pp_microbatches}")
+    if pp_microbatches:
+        from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
+
+        if cached_accum:
+            raise ValueError(
+                "accum_negatives='global' with pp_microbatches is not "
+                "supported (the pp forward is already whole-batch per "
+                "accumulation step — same constraint as make_train_step)"
+            )
+        if zero1:
+            raise ValueError(
+                "zero1 with pp_microbatches is not supported (see "
+                "make_train_step's rationale: the constrain would reshard "
+                "stage-local moments dp-wise every step)"
+            )
+        if pipeline_axis not in mesh_axis_names:
+            raise ValueError(
+                f"pp_microbatches={pp_microbatches} needs a mesh with a "
+                f"{pipeline_axis!r} axis, got {mesh_axis_names}"
+            )
+    if moe_aux_weight is not None and pp_microbatches:
+        raise ValueError(
+            "pp towers are dense (same constraint as make_train_step); "
+            "moe_aux_weight requires the non-pp compressed path"
+        )
+    if compression == "topk" and not error_feedback:
+        raise ValueError(
+            "compression='topk' without error feedback silently drops "
+            f"{(1 - topk_frac):.0%} of every gradient as pure bias; create "
+            "the state with with_error_feedback(state, mesh)"
+        )
+    if loss_variant != "all_gather":
+        raise ValueError(
+            "compressed DCN sync supports variant='all_gather' only (the ring "
+            "ppermute has no joint-(dcn,dp) axis form); use make_train_step "
+            "for ring training within a slice"
+        )
+    return cached_accum, acc_dt
+
+
 def make_compressed_train_step(
     model: nn.Module,
     mesh: Mesh,
@@ -187,20 +265,20 @@ def make_compressed_train_step(
     # round) is refused; the STE quant_train mode trains through this step's
     # manual region like any other dot.
     validate_trainable_quant(model)
-    acc_dt = validate_accum_args(accum_steps, accum_dtype)
-    if accum_negatives not in ("local", "global"):
-        raise ValueError(
-            f"accum_negatives must be 'local' or 'global', got {accum_negatives!r}"
-        )
-    cached_accum = accum_negatives == "global" and accum_steps > 1
-    if gradcache_embed_dtype is not None and not cached_accum:
-        raise ValueError(
-            f"gradcache_embed_dtype={gradcache_embed_dtype!r} requires "
-            "accum_negatives='global' with accum_steps > 1 (only the "
-            "GradCache path stashes embedding tables)"
-        )
-    if pp_microbatches < 0:
-        raise ValueError(f"pp_microbatches must be >= 0, got {pp_microbatches}")
+    cached_accum, acc_dt = validate_compressed_step_args(
+        accum_steps=accum_steps,
+        accum_dtype=accum_dtype,
+        accum_negatives=accum_negatives,
+        pp_microbatches=pp_microbatches,
+        zero1=zero1,
+        moe_aux_weight=moe_aux_weight,
+        gradcache_embed_dtype=gradcache_embed_dtype,
+        compression=compression,
+        error_feedback=error_feedback,
+        topk_frac=topk_frac,
+        loss_variant=loss_cfg.variant,
+        mesh_axis_names=mesh.axis_names,
+    )
     pp_size = 1
     if pp_microbatches:
         from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
@@ -208,43 +286,9 @@ def make_compressed_train_step(
             validate_pp_tower,
         )
 
-        if cached_accum:
-            raise ValueError(
-                "accum_negatives='global' with pp_microbatches is not "
-                "supported (the pp forward is already whole-batch per "
-                "accumulation step — same constraint as make_train_step)"
-            )
-        if zero1:
-            raise ValueError(
-                "zero1 with pp_microbatches is not supported (see "
-                "make_train_step's rationale: the constrain would reshard "
-                "stage-local moments dp-wise every step)"
-            )
-        if pipeline_axis not in mesh.axis_names:
-            raise ValueError(
-                f"pp_microbatches={pp_microbatches} needs a mesh with a "
-                f"{pipeline_axis!r} axis, got {mesh.axis_names}"
-            )
         pp_size = dict(mesh.shape)[pipeline_axis]
         validate_pp_tower(model.cfg.vision, pp_size, "vision")
         validate_pp_tower(model.cfg.text, pp_size, "text")
-    if moe_aux_weight is not None and pp_microbatches:
-        raise ValueError(
-            "pp towers are dense (same constraint as make_train_step); "
-            "moe_aux_weight requires the non-pp compressed path"
-        )
-    if compression == "topk" and not error_feedback:
-        raise ValueError(
-            "compression='topk' without error feedback silently drops "
-            f"{(1 - topk_frac):.0%} of every gradient as pure bias; create "
-            "the state with with_error_feedback(state, mesh)"
-        )
-    if loss_cfg.variant != "all_gather":
-        raise ValueError(
-            "compressed DCN sync supports variant='all_gather' only (the ring "
-            "ppermute has no joint-(dcn,dp) axis form); use make_train_step "
-            "for ring training within a slice"
-        )
     axis = loss_cfg.axis_name
     from distributed_sigmoid_loss_tpu.parallel.api import make_per_shard_loss
     from distributed_sigmoid_loss_tpu.train.train_step import (

@@ -185,6 +185,70 @@ def validate_accum_args(accum_steps: int, accum_dtype: str | None):
     return jnp.dtype(accum_dtype) if accum_dtype is not None else None
 
 
+def validate_step_args(
+    *,
+    accum_steps: int,
+    accum_dtype: str | None,
+    accum_negatives: str,
+    pp_microbatches: int,
+    zero1: bool,
+    moe_aux_weight: float | None,
+    gradcache_embed_dtype: str | None,
+    mesh_axis_names: tuple = ("dp",),
+):
+    """Pure config-compatibility refusals for :func:`make_train_step`,
+    returning ``(cached_accum, acc_dt)``.
+
+    Every refusal here is CONFIG-space — a pure statement about argument
+    compatibility, cross-checked against the declarative table in
+    analysis/config_space.py by the graftprove probe (which calls this with
+    a superset ``mesh_axis_names``). Environment checks (tower shapes via
+    validate_pp_tower, state contents) stay in make_train_step: they depend
+    on the model/mesh instance, not the config point.
+    """
+    if accum_negatives not in ("local", "global"):
+        raise ValueError(
+            f"accum_negatives must be 'local' or 'global', got {accum_negatives!r}"
+        )
+    # accum_steps == 1 with "global" is not an error — an unaccumulated step
+    # already contrasts globally — it just takes the plain path.
+    cached_accum = accum_negatives == "global" and accum_steps > 1
+    acc_dt = validate_accum_args(accum_steps, accum_dtype)
+    if gradcache_embed_dtype is not None and not cached_accum:
+        raise ValueError(
+            f"gradcache_embed_dtype={gradcache_embed_dtype!r} requires "
+            "accum_negatives='global' with accum_steps > 1 (only the "
+            "GradCache path stashes embedding tables)"
+        )
+    if cached_accum and pp_microbatches:
+        raise ValueError(
+            "accum_negatives='global' with pp_microbatches is not supported "
+            "(the pp forward is already whole-batch per accumulation step)"
+        )
+    if pp_microbatches < 0:
+        raise ValueError(f"pp_microbatches must be >= 0, got {pp_microbatches}")
+    if pp_microbatches:
+        from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
+
+        if moe_aux_weight is not None:
+            raise ValueError(
+                "pp towers are dense (Block.apply drops sown aux losses); "
+                "moe_aux_weight requires the non-pp path"
+            )
+        if zero1:
+            # zero1_constrain would re-shard the stage-local (pp-sharded) adam
+            # moments dp-wise on every step — defeating both memory stories
+            # with a silent per-step reshard. Refuse until a pp-aware ZeRO
+            # placement exists.
+            raise ValueError("zero1 with pp_microbatches is not supported")
+        if pipeline_axis not in mesh_axis_names:
+            raise ValueError(
+                f"pp_microbatches={pp_microbatches} needs a mesh with a "
+                f"{pipeline_axis!r} axis, got {mesh_axis_names}"
+            )
+    return cached_accum, acc_dt
+
+
 def accum_zeros(params, acc_dt):
     """Zeroed gradient accumulator in ``acc_dt`` (None = param dtype)."""
     return jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dt or p.dtype), params)
@@ -592,27 +656,16 @@ def make_train_step(
         # inline on >= 0.6.
         sharded_loss = jax.jit(sharded_loss)
 
-    if accum_negatives not in ("local", "global"):
-        raise ValueError(
-            f"accum_negatives must be 'local' or 'global', got {accum_negatives!r}"
-        )
-    # accum_steps == 1 with "global" is not an error — an unaccumulated step
-    # already contrasts globally — it just takes the plain path.
-    cached_accum = accum_negatives == "global" and accum_steps > 1
-    acc_dt = validate_accum_args(accum_steps, accum_dtype)
-    if gradcache_embed_dtype is not None and not cached_accum:
-        raise ValueError(
-            f"gradcache_embed_dtype={gradcache_embed_dtype!r} requires "
-            "accum_negatives='global' with accum_steps > 1 (only the "
-            "GradCache path stashes embedding tables)"
-        )
-    if cached_accum and pp_microbatches:
-        raise ValueError(
-            "accum_negatives='global' with pp_microbatches is not supported "
-            "(the pp forward is already whole-batch per accumulation step)"
-        )
-    if pp_microbatches < 0:
-        raise ValueError(f"pp_microbatches must be >= 0, got {pp_microbatches}")
+    cached_accum, acc_dt = validate_step_args(
+        accum_steps=accum_steps,
+        accum_dtype=accum_dtype,
+        accum_negatives=accum_negatives,
+        pp_microbatches=pp_microbatches,
+        zero1=zero1,
+        moe_aux_weight=moe_aux_weight,
+        gradcache_embed_dtype=gradcache_embed_dtype,
+        mesh_axis_names=mesh.axis_names,
+    )
     if pp_microbatches:
         from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
         from distributed_sigmoid_loss_tpu.parallel.pp_towers import (
@@ -620,22 +673,6 @@ def make_train_step(
             validate_pp_tower,
         )
 
-        if moe_aux_weight is not None:
-            raise ValueError(
-                "pp towers are dense (Block.apply drops sown aux losses); "
-                "moe_aux_weight requires the non-pp path"
-            )
-        if zero1:
-            # zero1_constrain would re-shard the stage-local (pp-sharded) adam
-            # moments dp-wise on every step — defeating both memory stories
-            # with a silent per-step reshard. Refuse until a pp-aware ZeRO
-            # placement exists.
-            raise ValueError("zero1 with pp_microbatches is not supported")
-        if pipeline_axis not in mesh.axis_names:
-            raise ValueError(
-                f"pp_microbatches={pp_microbatches} needs a mesh with a "
-                f"{pipeline_axis!r} axis, got {mesh.axis_names}"
-            )
         # Fail at build time, not first step: the model must expose its config
         # (SigLIP does) and both towers must be pipelineable.
         pp_stages = dict(mesh.shape)[pipeline_axis]

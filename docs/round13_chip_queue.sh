@@ -1,0 +1,88 @@
+#!/bin/bash
+# Round-13 chip measurement queue — the graftprove round: the step-config
+# space is now solver-enumerated (analysis/config_space.py: 1330 legal
+# configs) and the lint gate audits a pairwise-covering sample of ALL of
+# it, so every recipe below is a point the static layers have already
+# cleared (drift probe + shard-flow audit + proxy regression):
+#   nohup bash docs/round13_chip_queue.sh > /tmp/r13queue.log 2>&1 &
+#
+# PERF-STREAM DEBT NOTE (carry-forward): the last driver-verified headline
+# is STILL round 3's 761.74 pairs/s/chip (vs_baseline 0.692) — rounds
+# 4/5 recorded no-backend outages and the round-10/11/12 pallas,
+# _32k_equiv and serving-tier recipes have no ledgered chip numbers yet.
+# Ten rounds of program-level wins are stacked behind one verified
+# measurement; landing chip numbers is THE debt of this round, and every
+# entry below lands in LEDGER.jsonl with status + fingerprint either way.
+#
+# Same recovery-waiting discipline as rounds 5-12: one bounded probe per
+# cycle until the tunnel answers, then measurements cheapest-first. NEVER
+# signal a running bench process (SIGTERM mid-XLA-compile wedges the tunnel
+# — docs/PERF.md postmortems); fresh-compile configs ride the detached
+# compile shield automatically (a deferral record lands in the ledger too,
+# with the child's output file named).
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-12 queue.
+while pgrep -f round12_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+set -x
+# -1. Chip-free pre-flight (no backend needed, runs even if the probe loop
+#     above exhausted): the FULL-product lint pass (solver drift check +
+#     both jaxpr rule sets over the pairwise sample) and the proxy
+#     regression gate must be green BEFORE burning chip time on a config
+#     whose program already regressed or drifted out of the legal space.
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu lint --full-product
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu obs regress
+python -m distributed_sigmoid_loss_tpu obs ledger \
+  --metric siglip_vitb16_train_pairs_per_sec_per_chip
+
+# 0. Headline anchor first (cached compiles) — the perf stream needs ANY
+#    driver-verified train number this round; its ledger entry carries the
+#    device fingerprint that pins it.
+python bench.py
+
+# 1. Rounds-10..12 carry-forward, cheapest-first: the unverified pallas
+#    headline and the driver-verified _32k_equiv recipe.
+python bench.py 2048 10 b16 --use-pallas --metric-suffix _pallas
+python bench.py 4096 5 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather --loss-impl chunked \
+  --use-pallas --metric-suffix _32k_equiv
+
+# 2. New-to-the-lattice corners the solver sample now audits statically —
+#    measure the two whose proxies say the wire/FLOP mix moved most:
+#    ring+zero1 (sharded moments under the ppermute ring) and the GradCache
+#    global-negatives accumulation path.
+python bench.py 2048 10 b16 --variant ring --zero1 --metric-suffix _ring_zero1
+python bench.py 2048 10 b16 --accum 8 --accum-negatives global \
+  --metric-suffix _gradcache
+
+# 3. Serving tier under live telemetry (round-12 debt, unchanged recipe).
+python -m distributed_sigmoid_loss_tpu serve-bench --requests 512 \
+  --clients 8 --metrics-port 9091
+python bench.py 64 8 b16 --serve-bench --index-tier ann
+
+# 4. Close the loop: the trajectory WITH this round's entries, and an A/B
+#    of the newest headline against round 3's last verified number.
+python -m distributed_sigmoid_loss_tpu obs ledger \
+  --metric siglip_vitb16_train_pairs_per_sec_per_chip
+python -m distributed_sigmoid_loss_tpu obs diff \
+  siglip_vitb16_train_pairs_per_sec_per_chip@1 \
+  siglip_vitb16_train_pairs_per_sec_per_chip@-1

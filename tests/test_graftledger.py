@@ -209,12 +209,21 @@ def test_cli_obs_diff_selectors_and_errors(tmp_path, capsys):
     path = str(tmp_path / "ledger.jsonl")
     ledger_mod.backfill_round_files(repo_root=REPO_ROOT, path=path)
     metric = "siglip_vitb16_train_pairs_per_sec_per_chip"
-    # operand-first ordering: argparse consumes the positional chunk
-    # greedily, so `obs diff A B --ledger PATH` is the supported shape
     assert main(["obs", "diff", f"{metric}@0", f"{metric}@1",
                  "--ledger", path]) == 0
     out, _ = capsys.readouterr()
     assert "718.23" in out and "761.74" in out and "+6.1%" in out
+    # flags in ANY position: obs routes through parse_intermixed_args, so
+    # the ledger flag may precede or split the two operands (this was the
+    # PR 9 argparse-greediness bug — positionals used to swallow the flag)
+    for argv in (
+        ["obs", "diff", "--ledger", path, f"{metric}@0", f"{metric}@1"],
+        ["obs", "diff", f"{metric}@0", "--ledger", path, f"{metric}@1"],
+        ["obs", "--ledger", path, "diff", f"{metric}@0", f"{metric}@1"],
+    ):
+        assert main(argv) == 0, argv
+        out, _ = capsys.readouterr()
+        assert "+6.1%" in out, argv
     # a round file is a valid operand (its tail's last record)
     assert main(["obs", "diff", f"{metric}@0",
                  os.path.join(REPO_ROOT, "BENCH_r03.json"),
@@ -250,7 +259,7 @@ def test_regress_green_against_committed_baseline(proxies):
     out = io.StringIO()
     assert run_regress(current=proxies, stream=out) == 0, out.getvalue()
     text = out.getvalue()
-    assert "15 step configs" in text
+    assert "23 step configs" in text
     assert "green" in text
 
 

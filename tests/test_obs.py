@@ -527,8 +527,9 @@ def test_backward_attribution_sees_transpose_collectives():
 
 
 def test_six_step_configs_attribute_with_expected_structure():
-    """Static attribution over the SAME six-config enumeration graftlint
-    audits: every config counts flops and comm, the ring pair's traffic is
+    """Static attribution over the SAME step-config enumeration graftlint
+    audits (the solver-drawn tier-1 sample — a superset of the legacy
+    labels): every config counts flops and comm, the ring pair's traffic is
     identical, the all-gather pair's gather bytes agree, and the roofline
     estimate is a valid MFU bound everywhere."""
     from distributed_sigmoid_loss_tpu.analysis.jaxpr_audit import (
@@ -539,7 +540,7 @@ def test_six_step_configs_attribute_with_expected_structure():
     )
 
     att = step_config_attribution()
-    assert set(att) == set(DEFAULT_STEP_CONFIGS)
+    assert set(att) >= set(DEFAULT_STEP_CONFIGS)
     for label, costs in att.items():
         assert costs["flops_est"] > 0, label
         assert costs["comm_bytes_total"] > 0, label
