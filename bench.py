@@ -319,6 +319,11 @@ _SHIELD_EXEMPT_FLAGS = {
     "swap_every": "only meaningful with --serve-bench (shield trigger); "
                   "host-side churn cadence, and the swap path is "
                   "recompile-free by contract",
+    "serve_scenario": "only meaningful with --serve-bench (shield trigger); "
+                      "graftsiege traffic shaping is host-side — admission, "
+                      "shedding, and fault injection never change the "
+                      "compiled engine programs (the compile gate holds "
+                      "under chaos)",
 }
 
 
@@ -1144,7 +1149,9 @@ def run_serve_bench_mode(args) -> int:
         max_queue=1024, cache_size=4096, pool=64,
         index_size=256, topk=10, seed=0, mesh=False, cpu_devices=0,
         index_tier=args.index_tier, swap_every=args.swap_every, rerank_k=0,
-        metrics_port=-1,
+        metrics_port=-1, scenario=args.serve_scenario,
+        tenants="gold:prio=2,quota=24,slo=500;free:prio=1,rate=80,quota=8",
+        duration_s=4.0, offered_load=200.0, capacity=64,
     )
     if args.index_tier == "sharded":
         import jax
@@ -1328,6 +1335,14 @@ def main():
                     help="with --serve-bench: hot-swap weights + index "
                          "segments after every N client ops (0 = off); "
                          "swap latency percentiles land in the record")
+    ap.add_argument("--serve-scenario", default="",
+                    choices=["", "burst", "skew", "slowloris", "hostloss",
+                             "swapstorm"],
+                    help="with --serve-bench: run a graftsiege overload "
+                         "scenario soak instead of the fixed-request loop "
+                         "(multi-tenant admission, shaped offered load; the "
+                         "degradation record lands in LEDGER.jsonl — "
+                         "docs/SERVING.md 'Overload & SLO semantics')")
     ap.add_argument("--context", type=int, default=0, metavar="SEQ",
                     help="long-context attention bench INSTEAD of the train "
                          "bench: time one transformer block fwd+bwd at this "
@@ -1494,6 +1509,9 @@ def main():
         if args.swap_every:
             ap.error("--swap-every without --serve-bench would be a silent "
                      "no-op")
+        if args.serve_scenario:
+            ap.error("--serve-scenario without --serve-bench would be a "
+                     "silent no-op")
     if args.steps_per_call < 1 or args.steps % args.steps_per_call:
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")

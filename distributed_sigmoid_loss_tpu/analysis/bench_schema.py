@@ -93,6 +93,16 @@ BENCH_RECORD_FIELDS = frozenset(
         "index_tier", "swap_every", "index_version", "shard_count",
         "swap_count", "swap_latency_ms", "recall_at_k", "rerank_k",
         "search_stage_latency_ms",
+        # graftsiege (serve/siege.py run_scenario through cmd_serve_bench
+        # --scenario): the degradation record — scenario identity + offered
+        # load, the trailing shed rate, per-tenant outcome rows (sent / ok /
+        # shed / typed_errors / p99 vs slo), host-loss recovery time, and
+        # the zero-silent-drops counter the acceptance drill asserts on;
+        # plus the admission/swap fields the stats() snapshot spread carries
+        # (mirrored from obs/metrics_schema.py SERVE_STATS_FIELDS).
+        "scenario", "offered_load", "duration_s", "tenants", "per_tenant",
+        "shed_rate", "recovery_time_s", "silent_drops", "restarts",
+        "shed", "admission", "swap_in_flight", "inflight",
     )
 )
 

@@ -29,6 +29,13 @@ review:
   ledger (``obs/ledger.py append_record``) — a new emit path that prints its
   own JSON bypasses both the schema validator and the perf trajectory, the
   blind-spot class rounds 4/5 recorded 0.0 into.
+- ``repo-chaos-gate``: every fault-injection point in serve/ must be a
+  ``maybe_inject("<point>")`` call whose point is a string constant
+  registered in ``serve/siege.py CHAOS_POINTS`` with a non-empty rationale,
+  ``maybe_inject`` itself must check the ``chaos_enabled()`` gate, and
+  ``chaos_enabled`` must key on the ``DSL_CHAOS`` env hook — so injection
+  code is provably dead in production paths, and the registry stays an
+  honest inventory (stale rows fail too).
 
 All checks take explicit source/path inputs so tests can falsify each rule on
 a known-bad fixture; the defaults audit the real repo.
@@ -51,6 +58,7 @@ __all__ = [
     "check_bench_record_fields",
     "check_metrics_schema",
     "check_ledger_emit",
+    "check_chaos_gate",
     "MUTABLE_GLOBAL_ALLOWLIST",
     "SLOW_REQUIRED_TEST_MODULES",
     "METRICS_SCHEMA_FILES",
@@ -64,6 +72,7 @@ REPO_RULES = (
     "repo-bench-record",
     "repo-metrics-schema",
     "repo-ledger-emit",
+    "repo-chaos-gate",
 )
 
 _PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -107,6 +116,13 @@ MUTABLE_GLOBAL_ALLOWLIST = {
         "host-side memo for the ledger's environment fingerprint (git sha "
         "subprocess result); never read inside traced code — the ledger is "
         "a stdlib emit path"
+    ),
+    "serve/siege.py::_INJECTORS": (
+        "host-side armed-fault registry for the chaos harness; never read "
+        "inside traced code (injection happens on worker/host threads), "
+        "mutated only by install_fault/clear_faults under _INJECT_LOCK, and "
+        "dead in production: maybe_inject is gated on DSL_CHAOS "
+        "(statically enforced by repo-chaos-gate)"
     ),
     "analysis/jaxpr_audit.py::_STEP_CONFIG_CACHE": (
         "host-side per-label memo of the deterministic step-config traces "
@@ -617,6 +633,7 @@ METRICS_SCHEMA_FILES = {
     "train/compressed_step.py": "train",
     "cli.py": "train",
     "serve/service.py": "serve",
+    "serve/admission.py": "serve",
     "obs/health.py": "health",
 }
 
@@ -814,6 +831,181 @@ def check_ledger_emit(bench_source: str | None = None) -> list[Finding]:
     return findings
 
 
+def _chaos_registry(tree: ast.Module) -> dict[str, str] | None:
+    """CHAOS_POINTS {point: rationale} from siege's module body (string
+    constants only), or None when the dict is missing entirely."""
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "CHAOS_POINTS"
+            and isinstance(node.value, ast.Dict)
+        ):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                    continue
+                rationale = ""
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    rationale = v.value
+                elif isinstance(v, ast.JoinedStr):
+                    rationale = "<dynamic>"
+                out[k.value] = rationale
+            return out
+    return None
+
+
+def _maybe_inject_calls(tree: ast.Module) -> list[tuple[str | None, int]]:
+    """(point-or-None, lineno) for every maybe_inject(...) call; None marks
+    a non-constant point argument (unauditable — itself a finding)."""
+    calls = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name != "maybe_inject":
+            continue
+        point = None
+        if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+            node.args[0].value, str
+        ):
+            point = node.args[0].value
+        calls.append((point, node.lineno))
+    return calls
+
+
+def _calls_name(fn: ast.AST, target: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == target:
+                return True
+            if isinstance(f, ast.Attribute) and f.attr == target:
+                return True
+    return False
+
+
+def check_chaos_gate(
+    siege_source: str | None = None, serve_sources=None,
+) -> list[Finding]:
+    """repo-chaos-gate: fault injection provably dead in production paths.
+
+    Four statically-checkable halves: (a) ``maybe_inject`` must check the
+    ``chaos_enabled()`` gate before any fault can fire, and ``chaos_enabled``
+    must key on the ``DSL_CHAOS`` env hook; (b) every point in
+    ``CHAOS_POINTS`` carries a non-empty rationale; (c) every
+    ``maybe_inject(...)`` call site in serve/ names a registered point with
+    a STRING CONSTANT (a computed point is unauditable); (d) no registry row
+    is stale — a registered point nobody calls is a drill that silently
+    stopped existing.
+    """
+    serve_dir = os.path.join(_PACKAGE_DIR, "serve")
+    if siege_source is None:
+        with open(
+            os.path.join(serve_dir, "siege.py"), encoding="utf-8"
+        ) as f:
+            siege_source = f.read()
+    if serve_sources is None:
+        serve_sources = {
+            f"serve/{rel}": src
+            for rel, src in _iter_package_sources(serve_dir)
+        }
+    findings = []
+    siege_tree = ast.parse(siege_source)
+
+    # (a) the gate itself.
+    fns = {
+        node.name: node
+        for node in ast.walk(siege_tree)
+        if isinstance(node, ast.FunctionDef)
+    }
+    if "maybe_inject" not in fns:
+        findings.append(Finding(
+            "repo-chaos-gate", "serve/siege.py::maybe_inject",
+            "no maybe_inject function found — the chaos harness has no "
+            "gated injection entry point",
+        ))
+    elif not _calls_name(fns["maybe_inject"], "chaos_enabled"):
+        findings.append(Finding(
+            "repo-chaos-gate", "serve/siege.py::maybe_inject",
+            "maybe_inject does not check chaos_enabled() — an armed fault "
+            "would fire in production without the DSL_CHAOS hook; gate it",
+        ))
+    if "chaos_enabled" not in fns:
+        findings.append(Finding(
+            "repo-chaos-gate", "serve/siege.py::chaos_enabled",
+            "no chaos_enabled function found — nothing defines the "
+            "DSL_CHAOS gate",
+        ))
+    else:
+        reads_hook = any(
+            isinstance(n, ast.Constant) and n.value == "DSL_CHAOS"
+            for n in ast.walk(fns["chaos_enabled"])
+        )
+        if not reads_hook:
+            findings.append(Finding(
+                "repo-chaos-gate", "serve/siege.py::chaos_enabled",
+                "chaos_enabled does not reference the 'DSL_CHAOS' env hook "
+                "— the documented production off-switch is not what the "
+                "gate actually checks",
+            ))
+
+    # (b) the registry + rationales.
+    registry = _chaos_registry(siege_tree)
+    if registry is None:
+        findings.append(Finding(
+            "repo-chaos-gate", "serve/siege.py::CHAOS_POINTS",
+            "no CHAOS_POINTS dict found — injection points have no "
+            "registered inventory",
+        ))
+        registry = {}
+    for point, rationale in sorted(registry.items()):
+        if not rationale.strip():
+            findings.append(Finding(
+                "repo-chaos-gate", f"serve/siege.py::{point}",
+                f"chaos point {point!r} has no rationale — the registry "
+                "must say which failure mode the drill exists for",
+            ))
+
+    # (c) every call site names a registered constant point.
+    called: set[str] = set()
+    for rel in sorted(serve_sources):
+        for point, line in _maybe_inject_calls(ast.parse(serve_sources[rel])):
+            if rel.endswith("siege.py"):
+                continue  # the definition module, not an injection site
+            if point is None:
+                findings.append(Finding(
+                    "repo-chaos-gate", f"{rel}::maybe_inject",
+                    f"maybe_inject call at line {line} passes a computed "
+                    "point — unauditable; injection points must be string "
+                    "constants registered in CHAOS_POINTS",
+                ))
+                continue
+            called.add(point)
+            if point not in registry:
+                findings.append(Finding(
+                    "repo-chaos-gate", f"{rel}::{point}",
+                    f"maybe_inject({point!r}) at line {line} is not "
+                    "registered in serve/siege.py CHAOS_POINTS — register "
+                    "it with a rationale (ungated/undocumented injection "
+                    "points are exactly what this rule exists to prevent)",
+                ))
+
+    # (d) stale registry rows.
+    for point in sorted(set(registry) - called):
+        findings.append(Finding(
+            "repo-chaos-gate", f"serve/siege.py::{point}",
+            f"chaos point {point!r} is registered but no serve/ module "
+            "calls maybe_inject with it — stale inventory row; drop it or "
+            "wire the drill back in",
+        ))
+    return findings
+
+
 def run_repo_lint(disabled=()) -> list[Finding]:
     """Run every repo rule against the real tree."""
     checks = {
@@ -824,6 +1016,7 @@ def run_repo_lint(disabled=()) -> list[Finding]:
         "repo-bench-record": check_bench_record_fields,
         "repo-metrics-schema": check_metrics_schema,
         "repo-ledger-emit": check_ledger_emit,
+        "repo-chaos-gate": check_chaos_gate,
     }
     findings: list[Finding] = []
     for rule, fn in checks.items():

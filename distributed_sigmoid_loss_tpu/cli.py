@@ -1455,6 +1455,38 @@ def cmd_bench(extra: list[str]) -> int:
     os.execv(sys.executable, [sys.executable, bench] + extra)
 
 
+def _emit_serve_record(record: dict, *, strict_zero_drops: bool = False) -> int:
+    """The serve-bench emit contract (shared by the snapshot and scenario
+    paths): validate against the declared record schema, warn on stderr,
+    never lose the measurement, append to the run ledger. With
+    ``strict_zero_drops`` a non-zero ``silent_drops`` count fails the run —
+    the chaos scenarios' every-outcome-is-typed acceptance gate."""
+    import json
+
+    from distributed_sigmoid_loss_tpu.analysis.bench_schema import (
+        validate_record,
+    )
+    from distributed_sigmoid_loss_tpu.obs.ledger import append_record
+
+    problems = validate_record(record)
+    if problems:
+        print("WARNING: serve-bench record schema violation: "
+              + "; ".join(problems), file=sys.stderr)
+    print(json.dumps(record))
+    # graftledger: serve-bench/siege records join the same append-only
+    # trajectory as the train headline (obs/ledger.py; never fatal).
+    append_record(record, source="serve-bench", problems=problems)
+    if strict_zero_drops and record.get("silent_drops"):
+        print(
+            f"WARNING: {record['silent_drops']} silent drop(s) — a request "
+            "ended with neither a result nor a typed rejection; the "
+            "degradation contract is broken",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def cmd_serve_bench(args) -> int:
     """Drive the serve/ stack on synthetic data with concurrent clients and
     print the ``stats()`` snapshot as one JSON record (bench.py style).
@@ -1467,7 +1499,6 @@ def cmd_serve_bench(args) -> int:
     """
     _bootstrap_devices(args)
     import concurrent.futures
-    import json
     import threading
     import time
 
@@ -1505,6 +1536,38 @@ def cmd_serve_bench(args) -> int:
         print(f"--batch-buckets must be comma-separated ints, got "
               f"{args.batch_buckets!r}", file=sys.stderr)
         return 2
+
+    scenario_tenants = None
+    if args.scenario:
+        from distributed_sigmoid_loss_tpu.serve import parse_tenant_spec
+
+        if args.duration_s <= 0 or args.offered_load <= 0 or args.capacity < 1:
+            print("--duration-s/--offered-load must be > 0 and --capacity "
+                  ">= 1", file=sys.stderr)
+            return 2
+        try:
+            scenario_tenants = parse_tenant_spec(args.tenants)
+        except ValueError as e:
+            print(f"--tenants: {e}", file=sys.stderr)
+            return 2
+
+    if args.scenario == "hostloss":
+        # The host-loss drill runs the admission → batcher → EngineProcess
+        # stack with the stdlib surrogate worker: it drills the SERVING
+        # failure semantics (kill -9 mid-traffic, typed HostLostError to
+        # every in-flight caller, measured recovery), not the model forward
+        # — so it runs before the jitted stack spins up and the drill's
+        # child process never imports jax.
+        from distributed_sigmoid_loss_tpu.serve import hostloss_drill
+
+        record = hostloss_drill(
+            tenants=scenario_tenants,
+            duration_s=args.duration_s,
+            offered_load=args.offered_load,
+            capacity=args.capacity,
+            seed=args.seed,
+        )
+        return _emit_serve_record(record, strict_zero_drops=True)
 
     import jax
     from flax import linen as nn
@@ -1568,6 +1631,13 @@ def cmd_serve_bench(args) -> int:
         # bucket; client searches are single-query).
         router.search(corpus_emb[:1], k=args.topk)
 
+    admission = None
+    if args.scenario:
+        from distributed_sigmoid_loss_tpu.serve import AdmissionController
+
+        admission = AdmissionController(
+            scenario_tenants, capacity=args.capacity
+        )
     service = EmbeddingService(
         engine,
         cache=EmbeddingCache(args.cache_size),
@@ -1576,6 +1646,7 @@ def cmd_serve_bench(args) -> int:
         max_queue=args.max_queue,
         default_timeout=60.0,
         logger=MetricsLogger(),
+        admission=admission,
     )
     if args.metrics_port >= 0:
         # Live pull-based telemetry DURING the bench: the OpenMetrics-style
@@ -1584,6 +1655,77 @@ def cmd_serve_bench(args) -> int:
         exporter = service.start_metrics_server(port=args.metrics_port)
         print(f"serve-bench: live /metrics at {exporter.url}",
               file=sys.stderr)
+
+    if args.scenario:
+        # Scenario soak: graftsiege's generator replaces the fixed-request
+        # client loop — open-loop offered load shaped per scenario, real
+        # engine underneath, admission at the front door. The degradation
+        # record (p99 vs offered load, per-tenant shed_rate, recovery_time_s,
+        # silent_drops) merges with the stats() snapshot; any silent drop
+        # fails the run.
+        from distributed_sigmoid_loss_tpu.serve import run_scenario
+
+        swap_fn = None
+        if args.scenario == "swapstorm":
+            storm_controller = SwapController(engine, router)
+
+            def swap_fn() -> None:
+                storm_controller.swap(params=params, embeddings=corpus_emb)
+
+        def submit(tenant: str, i: int, *, items: int = 1,
+                   fresh: bool = False) -> None:
+            if fresh:
+                # Deterministic per-i cache-hostile row: always misses the
+                # cache, so every admit reaches the batcher/engine.
+                rng = np.random.default_rng(args.seed * 100003 + i)
+                row = rng.integers(0, cfg.text.vocab_size,
+                                   cfg.text.context_length, dtype=np.int32)
+                service.encode_text(row, tenant=tenant, timeout=5.0)
+            elif items > 1:
+                rows = np.stack(
+                    [pool_tokens[(i + j) % pool] for j in range(items)]
+                )
+                service.encode_text(rows, tenant=tenant, timeout=5.0)
+            else:
+                service.encode_text(pool_tokens[i % pool], tenant=tenant,
+                                    timeout=5.0)
+
+        scen = run_scenario(
+            args.scenario,
+            submit=submit,
+            tenants=scenario_tenants,
+            admission=admission,
+            duration_s=args.duration_s,
+            offered_load=args.offered_load,
+            clients_per_tenant=args.clients,
+            swap_fn=swap_fn,
+            seed=args.seed,
+        )
+        snap = service.stats()
+        service.close()
+        record = {
+            "model": args.model,
+            "clients": args.clients,
+            "batch_buckets": list(buckets),
+            "max_wait_ms": args.max_wait_ms,
+            "sharded": bool(mesh),
+            "index_tier": args.index_tier,
+            "swap_every": args.swap_every,
+            "warmup_s": round(warmup_s, 2),
+            **snap,
+            **scen,
+        }
+        rc = _emit_serve_record(record, strict_zero_drops=True)
+        # The steady-state compile gate holds under chaos too: shedding and
+        # swap churn must not push any request off the warmed bucket grid.
+        if snap["compile_count"] != warmed:
+            print(
+                f"WARNING: compile_count {snap['compile_count']} != warmed "
+                f"buckets {warmed} — a request triggered a fresh compile",
+                file=sys.stderr,
+            )
+            return 1
+        return rc
 
     # --swap-every N churn: a swapper thread republishes the weights and
     # freshly built index segments after every N completed client ops —
@@ -1657,22 +1799,7 @@ def cmd_serve_bench(args) -> int:
         "warmup_s": round(warmup_s, 2),
         **snap,
     }
-    # Same emit contract as bench.py's _emit: validate against the declared
-    # record schema, warn on stderr, never lose the measurement.
-    from distributed_sigmoid_loss_tpu.analysis.bench_schema import (
-        validate_record,
-    )
-
-    problems = validate_record(record)
-    if problems:
-        print("WARNING: serve-bench record schema violation: "
-              + "; ".join(problems), file=sys.stderr)
-    print(json.dumps(record))
-    # graftledger: serve-bench records join the same append-only trajectory
-    # as the train headline (obs/ledger.py; never fatal to the measurement).
-    from distributed_sigmoid_loss_tpu.obs.ledger import append_record
-
-    append_record(record, source="serve-bench", problems=problems)
+    rc = _emit_serve_record(record)
     # Steady-state contract: every compile happened at warmup — one per shape
     # bucket. A violation means a request escaped the bucket grid.
     if snap["compile_count"] != warmed:
@@ -1682,7 +1809,7 @@ def cmd_serve_bench(args) -> int:
             file=sys.stderr,
         )
         return 1
-    return 0
+    return rc
 
 
 def cmd_data_bench(args) -> int:
@@ -2509,6 +2636,33 @@ def main(argv=None) -> int:
                          "ephemeral port, printed on stderr; -1 = off) — "
                          "scrape qps/latency/compile_count mid-run "
                          "(docs/OBSERVABILITY.md 'graftledger')")
+    sb.add_argument("--scenario", default="",
+                    choices=["", "burst", "skew", "slowloris", "hostloss",
+                             "swapstorm"],
+                    help="graftsiege soak: replace the fixed-request client "
+                         "loop with a shaped overload scenario (open-loop "
+                         "offered load, multi-tenant admission at the front "
+                         "door) and emit the degradation record — p99 vs "
+                         "offered load, per-tenant shed_rate, "
+                         "recovery_time_s, silent_drops "
+                         "(docs/SERVING.md 'Overload & SLO semantics')")
+    sb.add_argument("--tenants",
+                    default="gold:prio=2,quota=24,slo=500;"
+                            "free:prio=1,rate=80,quota=8",
+                    metavar="SPEC",
+                    help="scenario tenant policies, ';'-separated "
+                         "name:key=value[,key=value...] rows (keys: prio, "
+                         "rate req/s, burst, quota in-flight items, slo ms)")
+    sb.add_argument("--duration-s", type=float, default=4.0,
+                    help="scenario soak duration (wall seconds of offered "
+                         "load; recovery measurement may extend past it)")
+    sb.add_argument("--offered-load", type=float, default=200.0,
+                    help="aggregate offered load across tenants (req/s) the "
+                         "scenario shapes — set ≥2x sustained capacity for "
+                         "the overload drill")
+    sb.add_argument("--capacity", type=int, default=64,
+                    help="AdmissionController global in-flight item budget "
+                         "(priority tiers partition it under overload)")
     sb.add_argument("--seed", type=int, default=0)
     sb.add_argument("--mesh", action="store_true",
                     help="shard engine batches over the dp mesh (batch "

@@ -66,6 +66,12 @@ SERVE_STATS_FIELDS = frozenset({
     # (fanout/merge/coarse/rerank/exact) latency percentiles.
     "index_tier", "index_version", "shard_count", "swap_count",
     "swap_latency_ms", "recall_at_k", "rerank_k", "search_stage_latency_ms",
+    # serve/admission.py (graftsiege): typed-shed counters distinct from the
+    # queue-full "rejected" stream, the trailing-window shed rate that also
+    # drives /healthz degraded, the nested AdmissionController.stats() row
+    # (capacity/inflight/per_tenant), and the router's mid-swap flag.
+    "shed", "shed_rate", "admission", "swap_in_flight",
+    "capacity", "inflight", "per_tenant",
 })
 
 # obs/health.py HealthEvent.record() — the structured watchdog events the

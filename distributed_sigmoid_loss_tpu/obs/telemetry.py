@@ -53,6 +53,10 @@ _NEST_LABEL = {
     "search_stage_latency_ms": "stage",
     "batch_size_hist": "modality",
     "cache": "field",
+    # serve/admission.py AdmissionController.stats(): the nested per-tenant
+    # rows flatten into tenant="..."-labelled series (the per-tenant hook).
+    "per_tenant": "tenant",
+    "admission": "field",
 }
 
 
@@ -94,7 +98,15 @@ def _flatten(
                 )
             else:
                 lbl = depth_label or "key"
-                yield from _flatten(name, v, {**labels, lbl: str(k)}, "key")
+                # The child's own depth label comes from the registry too, so
+                # a registered shape nested INSIDE another (admission stats'
+                # per_tenant map) still gets its tenant="..." label instead
+                # of a colliding generic "key".
+                yield from _flatten(
+                    name, v,
+                    {**labels, lbl: str(k)},
+                    _NEST_LABEL.get(str(k), "key"),
+                )
     # strings/None are handled by the caller (info series); other types skip
 
 
@@ -158,12 +170,18 @@ class TelemetryExporter:
         prefix: str = "dsl_serve",
         labels: Mapping[str, str] | None = None,
         refresh_s: float = 0.25,
+        health_fn: Callable[[], Mapping] | None = None,
     ):
         self.snapshot_fn = snapshot_fn
         self.host = host
         self.prefix = prefix
         self.labels = dict(labels or {})
         self.refresh_s = float(refresh_s)
+        # Optional richer /healthz: merged into the liveness payload, so a
+        # serving stack can report status="degraded" (still HTTP 200 — the
+        # process is up) while shedding or mid-swap. Without it the payload
+        # stays the bare {"ok": true} liveness contract.
+        self.health_fn = health_fn
         self._requested_port = port
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -204,7 +222,10 @@ class TelemetryExporter:
                     body = exporter.payload()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.split("?", 1)[0] == "/healthz":
-                    body = json.dumps({"ok": True}).encode()
+                    health: dict = {"ok": True}
+                    if exporter.health_fn is not None:
+                        health.update(exporter.health_fn())
+                    body = json.dumps(health).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

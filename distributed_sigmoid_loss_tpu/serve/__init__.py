@@ -31,11 +31,18 @@ whole stack on synthetic data and prints the stats snapshot as JSON
 churn).
 """
 
+from distributed_sigmoid_loss_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    ShedError,
+    TenantPolicy,
+    parse_tenant_spec,
+)
 from distributed_sigmoid_loss_tpu.serve.ann import AnnIndex  # noqa: F401
 from distributed_sigmoid_loss_tpu.serve.batcher import (  # noqa: F401
     BatcherClosedError,
     MicroBatcher,
     QueueFullError,
+    ShutdownError,
 )
 from distributed_sigmoid_loss_tpu.serve.cache import (  # noqa: F401
     EmbeddingCache,
@@ -51,20 +58,45 @@ from distributed_sigmoid_loss_tpu.serve.service import (  # noqa: F401
 from distributed_sigmoid_loss_tpu.serve.shard_index import (  # noqa: F401
     ShardedIndex,
 )
+from distributed_sigmoid_loss_tpu.serve.siege import (  # noqa: F401
+    CHAOS_POINTS,
+    SCENARIOS,
+    EngineProcess,
+    HostLostError,
+    chaos_enabled,
+    hostloss_drill,
+    inject,
+    maybe_inject,
+    run_scenario,
+)
 from distributed_sigmoid_loss_tpu.serve.swap import SwapController  # noqa: F401
 
 __all__ = [
+    "AdmissionController",
     "AnnIndex",
     "BatcherClosedError",
+    "CHAOS_POINTS",
     "EmbeddingCache",
     "EmbeddingService",
+    "EngineProcess",
+    "HostLostError",
     "InferenceEngine",
     "MicroBatcher",
     "QueueFullError",
     "RequestTimeoutError",
     "RetrievalIndex",
     "RetrievalRouter",
+    "SCENARIOS",
     "ShardedIndex",
+    "ShedError",
+    "ShutdownError",
     "SwapController",
+    "TenantPolicy",
+    "chaos_enabled",
     "content_key",
+    "hostloss_drill",
+    "inject",
+    "maybe_inject",
+    "parse_tenant_spec",
+    "run_scenario",
 ]

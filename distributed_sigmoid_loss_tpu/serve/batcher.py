@@ -33,15 +33,22 @@ import queue
 import threading
 import time
 from collections import Counter
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
+from distributed_sigmoid_loss_tpu.serve.siege import maybe_inject
 from distributed_sigmoid_loss_tpu.utils.logging import LatencyWindow
 
 BATCH_STAGES = ("queue_wait", "assembly", "device", "reply")
 
-__all__ = ["MicroBatcher", "QueueFullError", "BatcherClosedError", "BATCH_STAGES"]
+__all__ = [
+    "MicroBatcher",
+    "QueueFullError",
+    "BatcherClosedError",
+    "ShutdownError",
+    "BATCH_STAGES",
+]
 
 
 class QueueFullError(RuntimeError):
@@ -52,6 +59,11 @@ class BatcherClosedError(RuntimeError):
     """submit() after close(): the worker is draining/stopped."""
 
 
+class ShutdownError(RuntimeError):
+    """The batcher shut down with this request still queued: a typed
+    rejection, never a hung future — the close() drain guarantee."""
+
+
 @dataclass
 class _Request:
     item: Any
@@ -60,6 +72,26 @@ class _Request:
 
 
 _SENTINEL = object()
+
+
+def _resolve(req: "_Request", result) -> None:
+    """Set a result, tolerating a future already failed by the close-side
+    drain sweep (the worker and the sweep may race; exactly one wins)."""
+    if req.future.cancelled():
+        return
+    try:
+        req.future.set_result(result)
+    except InvalidStateError:
+        pass
+
+
+def _fail(req: "_Request", exc: BaseException) -> None:
+    if req.future.cancelled():
+        return
+    try:
+        req.future.set_exception(exc)
+    except InvalidStateError:
+        pass
 
 
 class MicroBatcher:
@@ -120,10 +152,21 @@ class MicroBatcher:
                 f"batcher queue full ({self._queue.maxsize} pending); "
                 "retry later or raise max_queue"
             ) from None
+        if self._closed:
+            # close() raced our enqueue: the worker may already be past its
+            # final drain, which would leave this future hung forever. Fail
+            # it typed; if the worker DOES still serve it, the safe setters
+            # let exactly one side win.
+            _fail(req, ShutdownError("batcher shut down while request queued"))
         return req.future
 
     def close(self, *, wait: bool = True) -> None:
-        """Stop accepting work; the worker drains what is already queued."""
+        """Stop accepting work; the worker drains what is already queued.
+
+        Drain guarantee: every request that made it into the queue is either
+        answered by the worker or failed with :class:`ShutdownError` — a
+        ``fut.result()`` can never hang on a closed batcher.
+        """
         if self._closed:
             return
         self._closed = True
@@ -132,6 +175,20 @@ class MicroBatcher:
         self._queue.put(_SENTINEL)
         if wait:
             self._worker.join()
+            # Final sweep: anything enqueued after the worker's own drain
+            # (submit racing close) gets the typed rejection here.
+            self._drain_reject()
+
+    def _drain_reject(self) -> None:
+        """Fail everything still queued with ShutdownError (sentinels skipped)."""
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if req is _SENTINEL:
+                continue
+            _fail(req, ShutdownError("batcher shut down while request queued"))
 
     def __enter__(self):
         return self
@@ -190,6 +247,9 @@ class MicroBatcher:
         while True:
             collected = self._collect()
             if collected is None:
+                # Sentinel: reject anything that slipped in behind it before
+                # the worker exits (the drain guarantee's worker-side half).
+                self._drain_reject()
                 return
             batch, t_assembly = collected
             t_run = time.monotonic()
@@ -201,12 +261,14 @@ class MicroBatcher:
             with self._hist_lock:
                 self._batch_sizes[len(batch)] += 1
             try:
+                # Chaos point: a wedged worker (stall) or a pre-engine fault;
+                # dead unless DSL_CHAOS=1 AND a fault is armed (serve/siege).
+                maybe_inject("batcher.stall")
                 results = self._run_batch([r.item for r in batch])
             except Exception as e:  # noqa: BLE001 — fan the failure out
                 self._stage("device", t_run, time.monotonic())
                 for r in batch:
-                    if not r.future.cancelled():
-                        r.future.set_exception(e)
+                    _fail(r, e)
                 continue
             t_reply = time.monotonic()
             self._stage("device", t_run, t_reply)
@@ -216,10 +278,8 @@ class MicroBatcher:
                     f"{len(batch)} items"
                 )
                 for r in batch:
-                    if not r.future.cancelled():
-                        r.future.set_exception(err)
+                    _fail(r, err)
                 continue
             for r, res in zip(batch, results):
-                if not r.future.cancelled():
-                    r.future.set_result(res)
+                _resolve(r, res)
             self._stage("reply", t_reply, time.monotonic())

@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
+from distributed_sigmoid_loss_tpu.serve.siege import maybe_inject
 
 __all__ = ["InferenceEngine"]
 
@@ -180,6 +181,11 @@ class InferenceEngine:
         )
 
     def _run(self, kind: str, padded: np.ndarray) -> np.ndarray:
+        # Chaos points (serve/siege.py): a slow or faulting accelerator step.
+        # Dead unless DSL_CHAOS=1 AND a fault is armed; a raise here fans out
+        # typed through the batcher's futures, never a hang.
+        maybe_inject("engine.latency")
+        maybe_inject("engine.exception")
         if self.mesh is not None:
             spec = P(self.batch_axis, *([None] * (padded.ndim - 1)))
             padded = jax.device_put(padded, NamedSharding(self.mesh, spec))

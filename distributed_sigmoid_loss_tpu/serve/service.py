@@ -27,6 +27,10 @@ import numpy as np
 
 from distributed_sigmoid_loss_tpu.eval.retrieval import merge_topk
 from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
+from distributed_sigmoid_loss_tpu.serve.admission import (
+    AdmissionController,
+    ShedError,
+)
 from distributed_sigmoid_loss_tpu.serve.ann import AnnIndex
 from distributed_sigmoid_loss_tpu.serve.batcher import MicroBatcher, QueueFullError
 from distributed_sigmoid_loss_tpu.serve.cache import EmbeddingCache, content_key
@@ -117,6 +121,7 @@ class RetrievalRouter:
         self._versions = 0
         self._stats_lock = threading.Lock()
         self._swap_count = 0
+        self._swaps_in_flight = 0
         self._swap_window = LatencyWindow(1024)
         self._stage_windows = {s: LatencyWindow(4096) for s in self.STAGES}
         self._searches = 0
@@ -175,6 +180,21 @@ class RetrievalRouter:
         with self._stats_lock:
             self._swap_count += 1
         self._swap_window.record(seconds)
+
+    def begin_swap(self) -> None:
+        """Mark a hot swap mid-flight (SwapController, before the build);
+        ``/healthz`` reports ``degraded`` while any swap is in progress."""
+        with self._stats_lock:
+            self._swaps_in_flight += 1
+
+    def end_swap(self) -> None:
+        with self._stats_lock:
+            self._swaps_in_flight = max(0, self._swaps_in_flight - 1)
+
+    @property
+    def swap_in_flight(self) -> bool:
+        with self._stats_lock:
+            return self._swaps_in_flight > 0
 
     # -- search --------------------------------------------------------------
 
@@ -276,6 +296,7 @@ class RetrievalRouter:
                 for s, w in self._stage_windows.items()
                 if w.count
             },
+            "swap_in_flight": self.swap_in_flight,
         }
         return snap
 
@@ -304,6 +325,7 @@ class EmbeddingService:
         max_wait_ms: float = 5.0,
         max_queue: int = 1024,
         default_timeout: float | None = 10.0,
+        admission: AdmissionController | None = None,
         logger: MetricsLogger | None = None,
         spans=None,
     ):
@@ -312,6 +334,10 @@ class EmbeddingService:
         self.cache = cache
         self.index = index if index is not None else RetrievalIndex()
         self.default_timeout = default_timeout
+        # Optional serve/admission.py front door: per-tenant token buckets,
+        # bounded quotas, priority-ordered shedding. When wired, encode/search
+        # accept tenant= and may raise ShedError BEFORE touching the batcher.
+        self.admission = admission
         self.logger = logger
         # Optional obs/spans.py SpanRecorder: per-request spans on the caller
         # threads plus per-stage (queue-wait / assembly / device / reply)
@@ -337,6 +363,7 @@ class EmbeddingService:
         self._items = 0
         self._rejected = 0
         self._timeouts = 0
+        self._shed = 0
         self._started = time.monotonic()
         self._exporter = None  # live /metrics endpoint (start_metrics_server)
 
@@ -386,9 +413,41 @@ class EmbeddingService:
                 rows[i] = row
         return [np.asarray(r, dtype=self.engine.token_dtype) for r in rows]
 
-    def _encode(self, kind: str, rows: list[np.ndarray], timeout) -> np.ndarray:
-        t0 = time.monotonic()
+    def _admit(self, tenant, items: int, deadline_s):
+        """Pass the admission front door (or raise the typed ShedError).
+        Returns the ticket to release, or None when no admission is wired."""
+        if self.admission is None:
+            return None
+        try:
+            return self.admission.admit(
+                tenant, items=items, deadline_s=deadline_s
+            )
+        except ShedError:
+            with self._lock:
+                self._shed += 1
+            raise
+
+    def _encode(
+        self, kind: str, rows: list[np.ndarray], timeout, tenant=None
+    ) -> np.ndarray:
         timeout = self.default_timeout if timeout is None else timeout
+        # Admission covers the whole request (cache probe included): the
+        # quota a tenant holds is its end-to-end concurrency, and the token
+        # bucket meters offered rate, not just cache misses.
+        ticket = self._admit(tenant, len(rows), timeout)
+        ok = False
+        try:
+            out = self._encode_batched(kind, rows, timeout)
+            ok = True
+            return out
+        finally:
+            if ticket is not None:
+                ticket.release(ok=ok)
+
+    def _encode_batched(
+        self, kind: str, rows: list[np.ndarray], timeout
+    ) -> np.ndarray:
+        t0 = time.monotonic()
         results: list[np.ndarray | None] = [None] * len(rows)
         pending: list[tuple[int, str | None, object]] = []
         try:
@@ -433,16 +492,20 @@ class EmbeddingService:
                 self.spans.record(f"serve/request/{kind}", t0, t1)
         return np.stack(results)
 
-    def encode_text(self, texts, *, timeout: float | None = None) -> np.ndarray:
+    def encode_text(
+        self, texts, *, timeout: float | None = None, tenant: str | None = None
+    ) -> np.ndarray:
         """Texts (strings or token rows) → (n, embed_dim) embeddings."""
-        return self._encode("text", self._normalize_text(texts), timeout)
+        return self._encode("text", self._normalize_text(texts), timeout, tenant)
 
-    def encode_image(self, images, *, timeout: float | None = None) -> np.ndarray:
+    def encode_image(
+        self, images, *, timeout: float | None = None, tenant: str | None = None
+    ) -> np.ndarray:
         """(n, h, w, 3) or (h, w, 3) pixels → (n, embed_dim) embeddings."""
         arr = np.asarray(images, dtype=np.float32)
         if arr.ndim == 3:
             arr = arr[None]
-        return self._encode("image", list(arr), timeout)
+        return self._encode("image", list(arr), timeout, tenant)
 
     def search(
         self,
@@ -450,6 +513,7 @@ class EmbeddingService:
         k: int = 10,
         *,
         timeout: float | None = None,
+        tenant: str | None = None,
         return_version: bool = False,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Top-k over the index. Queries: strings / int token rows (encoded
@@ -460,9 +524,23 @@ class EmbeddingService:
         """
         arr = queries if isinstance(queries, np.ndarray) else None
         if arr is not None and np.issubdtype(arr.dtype, np.floating):
-            emb = arr  # already embeddings
-        else:
-            emb = self.encode_text(queries, timeout=timeout)
+            # Already embeddings: no encode path, so the admission check
+            # (one per request) happens here instead of inside _encode.
+            n = arr.shape[0] if arr.ndim > 1 else 1
+            deadline = self.default_timeout if timeout is None else timeout
+            ticket = self._admit(tenant, n, deadline)
+            ok = False
+            try:
+                if return_version:
+                    out = self.index.search(arr, k, return_version=True)
+                else:
+                    out = self.index.search(arr, k)
+                ok = True
+                return out
+            finally:
+                if ticket is not None:
+                    ticket.release(ok=ok)
+        emb = self.encode_text(queries, timeout=timeout, tenant=tenant)
         if return_version:
             return self.index.search(emb, k, return_version=True)
         return self.index.search(emb, k)
@@ -475,6 +553,7 @@ class EmbeddingService:
         with self._lock:
             requests, items = self._requests, self._items
             rejected, timeouts = self._rejected, self._timeouts
+            shed = self._shed
         snap = {
             "uptime_s": round(elapsed, 3),
             "requests": requests,
@@ -495,18 +574,50 @@ class EmbeddingService:
             },
             "rejected": rejected,
             "timeouts": timeouts,
+            # Admission sheds are a SEPARATE stream from queue-full rejects:
+            # shed = policy said no (tenant over rate/quota or shed by
+            # priority), rejected = the whole stack was saturated.
+            "shed": shed,
+            "shed_rate": (
+                round(self.admission.recent_shed_rate(), 4)
+                if self.admission is not None
+                else 0.0
+            ),
             "compile_count": self.engine.compile_count,
             "bucket_space": self.engine.bucket_space,
             "index_size": len(self.index),
         }
         if self.cache is not None:
             snap["cache"] = self.cache.stats()
+        if self.admission is not None:
+            snap["admission"] = self.admission.stats()
         if isinstance(self.index, RetrievalRouter):
             # Tier/version/swap/recall fields — the router emits only keys
             # registered in the SERVE schema, so the merged snapshot stays
             # schema-valid end to end.
             snap.update(self.index.stats())
         return snap
+
+    def health(self) -> dict:
+        """The ``/healthz`` payload: ``degraded`` (still HTTP 200 — the
+        process is up and answering) while admission is actively shedding
+        or a hot swap is mid-flight, ``ok`` otherwise."""
+        shed_rate = (
+            self.admission.recent_shed_rate()
+            if self.admission is not None
+            else 0.0
+        )
+        swap = (
+            self.index.swap_in_flight
+            if isinstance(self.index, RetrievalRouter)
+            else False
+        )
+        status = "degraded" if (shed_rate > 0 or swap) else "ok"
+        return {
+            "status": status,
+            "shed_rate": round(shed_rate, 4),
+            "swap_in_flight": bool(swap),
+        }
 
     def start_metrics_server(
         self,
@@ -531,7 +642,7 @@ class EmbeddingService:
             raise RuntimeError("metrics server already started")
         self._exporter = TelemetryExporter(
             self.stats, host=host, port=port, labels=labels,
-            refresh_s=refresh_s,
+            refresh_s=refresh_s, health_fn=self.health,
         )
         self._exporter.start()
         return self._exporter

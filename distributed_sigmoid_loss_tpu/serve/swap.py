@@ -34,6 +34,7 @@ import time
 
 from distributed_sigmoid_loss_tpu.serve.engine import InferenceEngine
 from distributed_sigmoid_loss_tpu.serve.service import RetrievalRouter
+from distributed_sigmoid_loss_tpu.serve.siege import maybe_inject
 
 __all__ = ["SwapController"]
 
@@ -65,16 +66,26 @@ class SwapController:
             raise ValueError("swap() needs params and/or embeddings")
         t0 = time.perf_counter()
         with self._lock:
-            # Double-buffered build: the expensive half happens while the
-            # old version keeps serving every request.
-            built = (
-                self.router.build(embeddings, ids)
-                if embeddings is not None
-                else None
-            )
-            if params is not None:
-                self.engine.swap_params(params)  # validated: zero recompiles
-            version = self.router.publish_built(built)
+            # Mark the swap mid-flight for the whole build+publish window:
+            # /healthz reports degraded until end_swap (the swapstorm drill
+            # asserts the window is visible, and that it always closes).
+            self.router.begin_swap()
+            try:
+                # Chaos point: stretch/fault the swap window under load
+                # (dead unless DSL_CHAOS=1 — serve/siege.py).
+                maybe_inject("swap.storm")
+                # Double-buffered build: the expensive half happens while the
+                # old version keeps serving every request.
+                built = (
+                    self.router.build(embeddings, ids)
+                    if embeddings is not None
+                    else None
+                )
+                if params is not None:
+                    self.engine.swap_params(params)  # zero recompiles
+                version = self.router.publish_built(built)
+            finally:
+                self.router.end_swap()
         t1 = time.perf_counter()
         self.router.record_swap(t1 - t0)
         if self.router.spans is not None:

@@ -72,6 +72,7 @@ _STANDARD_MODULES = {
     "test_obs",
     "test_pipeline",
     "test_serve",
+    "test_siege",
     "test_streamed_loss",
     "test_torch_reference_parity",
 }

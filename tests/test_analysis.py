@@ -769,3 +769,94 @@ def test_run_lint_full_green():
     probe + both jaxpr rule sets over the tier-1 sample."""
     findings = run_lint()
     assert findings == [], [str(f) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# repo-chaos-gate (graftsiege): fault injection provably dead in production
+# ---------------------------------------------------------------------------
+
+_GOOD_SIEGE_FIXTURE = '''
+import os
+
+CHAOS_POINTS = {"engine.latency": "slow accelerator step degradation drill"}
+
+def chaos_enabled():
+    return os.environ.get("DSL_CHAOS", "") == "1"
+
+def maybe_inject(point):
+    if point not in CHAOS_POINTS:
+        raise KeyError(point)
+    if not chaos_enabled():
+        return
+'''
+
+_GOOD_SERVE_FIXTURE = {
+    "serve/engine.py": 'maybe_inject("engine.latency")\n',
+}
+
+
+def test_chaos_gate_green_on_minimal_fixture_and_shipped_tree():
+    assert repo_lint.check_chaos_gate(
+        siege_source=_GOOD_SIEGE_FIXTURE, serve_sources=_GOOD_SERVE_FIXTURE
+    ) == []
+    findings = repo_lint.check_chaos_gate()
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_chaos_gate_trips_on_ungated_maybe_inject():
+    """The load-bearing half: a maybe_inject that fires without checking
+    chaos_enabled() is an injection point live in production."""
+    ungated = _GOOD_SIEGE_FIXTURE.replace(
+        "    if not chaos_enabled():\n        return\n", "    pass\n"
+    )
+    findings = repo_lint.check_chaos_gate(
+        siege_source=ungated, serve_sources=_GOOD_SERVE_FIXTURE
+    )
+    assert _rules_of(findings) == ["repo-chaos-gate"]
+    assert findings[0].subject == "serve/siege.py::maybe_inject"
+
+
+def test_chaos_gate_trips_when_gate_ignores_dsl_chaos_hook():
+    wrong_hook = _GOOD_SIEGE_FIXTURE.replace('"DSL_CHAOS"', '"OTHER_VAR"')
+    findings = repo_lint.check_chaos_gate(
+        siege_source=wrong_hook, serve_sources=_GOOD_SERVE_FIXTURE
+    )
+    assert [f.subject for f in findings] == ["serve/siege.py::chaos_enabled"]
+
+
+def test_chaos_gate_trips_on_empty_rationale():
+    no_why = _GOOD_SIEGE_FIXTURE.replace(
+        '"slow accelerator step degradation drill"', '""'
+    )
+    findings = repo_lint.check_chaos_gate(
+        siege_source=no_why, serve_sources=_GOOD_SERVE_FIXTURE
+    )
+    assert [f.subject for f in findings] == ["serve/siege.py::engine.latency"]
+
+
+def test_chaos_gate_trips_on_unregistered_and_computed_call_sites():
+    bad_sites = {
+        "serve/engine.py": 'maybe_inject("engine.latency")\n'
+                           'maybe_inject("engine.unregistered")\n',
+        "serve/swap.py": 'maybe_inject(point_var)\n',
+    }
+    findings = repo_lint.check_chaos_gate(
+        siege_source=_GOOD_SIEGE_FIXTURE, serve_sources=bad_sites
+    )
+    subjects = sorted(f.subject for f in findings)
+    assert subjects == [
+        "serve/engine.py::engine.unregistered",
+        "serve/swap.py::maybe_inject",
+    ]
+    assert set(_rules_of(findings)) == {"repo-chaos-gate"}
+
+
+def test_chaos_gate_trips_on_stale_registry_row():
+    """A registered point nobody calls is a drill that silently stopped
+    existing — the registry must mirror the real call sites."""
+    findings = repo_lint.check_chaos_gate(
+        siege_source=_GOOD_SIEGE_FIXTURE,
+        serve_sources={"serve/engine.py": "x = 1\n"},
+    )
+    assert [f.subject for f in findings] == ["serve/siege.py::engine.latency"]
+    assert "stale" in findings[0].detail

@@ -1,0 +1,562 @@
+"""graftsiege: admission control, load shedding, chaos injection, host loss.
+
+The overload and failure contracts under test, in dependency order:
+
+- AdmissionController: token-bucket rate limits, bounded per-tenant quotas,
+  priority-tiered capacity shedding (low priority first), exponential
+  deadline-aware backoff guidance that never retry-storms.
+- Chaos gate: every injection point is registered + dead unless DSL_CHAOS=1
+  AND a fault is armed; unregistered points fail loudly (KeyError).
+- MicroBatcher drain guarantee: close() under concurrent clients answers
+  every future (result or typed ShutdownError) — never a hung fut.result.
+- EngineProcess: kill -9 surfaces as typed HostLostError to in-flight
+  callers; restart() measures recovery.
+- run_scenario / hostloss_drill: all five scenarios emit schema-valid
+  degradation records with zero silent drops.
+- /healthz: degraded (still HTTP 200) while shedding or mid-swap.
+
+Everything here is stdlib + numpy — the engine is either the stub below or
+the EngineProcess echo worker; no jax program compiles in this module.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_sigmoid_loss_tpu.analysis.bench_schema import validate_record
+from distributed_sigmoid_loss_tpu.obs.metrics_schema import (
+    SERVE_STATS_FIELDS,
+    validate_metrics,
+)
+from distributed_sigmoid_loss_tpu.serve import (
+    AdmissionController,
+    EmbeddingService,
+    EngineProcess,
+    HostLostError,
+    MicroBatcher,
+    QueueFullError,
+    ShedError,
+    ShutdownError,
+    TenantPolicy,
+    hostloss_drill,
+    inject,
+    maybe_inject,
+    parse_tenant_spec,
+    run_scenario,
+)
+from distributed_sigmoid_loss_tpu.serve.batcher import BatcherClosedError
+from distributed_sigmoid_loss_tpu.serve.siege import (
+    CHAOS_POINTS,
+    chaos_enabled,
+    clear_faults,
+    install_fault,
+)
+
+# ---------------------------------------------------------------------------
+# AdmissionController (pure host-side logic)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_tenant_spec_round_trip_and_errors():
+    pols = parse_tenant_spec(
+        "gold:prio=2,quota=16,slo=250;free:prio=1,rate=40,burst=8,quota=4"
+    )
+    by_name = {p.name: p for p in pols}
+    assert by_name["gold"].priority == 2
+    assert by_name["gold"].max_inflight == 16
+    assert by_name["gold"].slo_ms == 250.0
+    assert by_name["free"].rate == 40.0
+    assert by_name["free"].burst == 8
+    with pytest.raises(ValueError):
+        parse_tenant_spec("gold:wat=1")
+    with pytest.raises(ValueError):
+        parse_tenant_spec("")
+
+
+def test_token_bucket_sheds_over_rate_with_retry_guidance():
+    adm = AdmissionController(
+        [TenantPolicy("free", rate=10.0, burst=2)], capacity=64
+    )
+    for _ in range(2):  # the burst depth admits immediately
+        adm.admit("free").release()
+    with pytest.raises(ShedError) as ei:
+        adm.admit("free")
+    assert ei.value.reason == "rate"
+    assert ei.value.retry_after_s > 0
+    assert ei.value.retriable
+    # Tokens refill at the contracted rate: after a wait, admission resumes.
+    time.sleep(0.15)
+    adm.admit("free").release()
+
+
+def test_quota_bounds_inflight_and_release_frees_it():
+    adm = AdmissionController(
+        [TenantPolicy("t", max_inflight=2)], capacity=64
+    )
+    t1 = adm.admit("t")
+    t2 = adm.admit("t")
+    with pytest.raises(ShedError) as ei:
+        adm.admit("t")
+    assert ei.value.reason == "quota"
+    t1.release()
+    t3 = adm.admit("t")  # freed slot is admittable again
+    t2.release()
+    t3.release()
+    assert adm.stats()["inflight"] == 0
+
+
+def test_priority_tiers_shed_low_priority_first():
+    """capacity=4, priorities {1, 2}: the low tier owns 2 slots, the high
+    tier the full 4 — under load the free tenant sheds while gold admits."""
+    adm = AdmissionController(
+        [TenantPolicy("gold", priority=2), TenantPolicy("free", priority=1)],
+        capacity=4,
+    )
+    held = [adm.admit("free"), adm.admit("free")]
+    with pytest.raises(ShedError) as ei:
+        adm.admit("free")
+    assert ei.value.reason == "overload"
+    held.append(adm.admit("gold"))
+    held.append(adm.admit("gold"))  # gold rides to full capacity
+    with pytest.raises(ShedError):
+        adm.admit("gold")  # ... but not past it
+    for t in held:
+        t.release()
+
+
+def test_backoff_grows_with_consecutive_sheds_and_respects_deadline():
+    adm = AdmissionController(
+        [TenantPolicy("t", max_inflight=1)], capacity=64
+    )
+    held = adm.admit("t")
+    waits = []
+    for _ in range(6):
+        with pytest.raises(ShedError) as ei:
+            adm.admit("t")
+        waits.append(ei.value.retry_after_s)
+    # Exponential guidance: the 6th consecutive shed suggests a much longer
+    # wait than the 1st (jitter is bounded in [0.75, 1.25), so 2^5 growth
+    # dominates it).
+    assert waits[-1] > waits[0] * 4
+    # A wait beyond the caller's remaining deadline is marked hopeless.
+    with pytest.raises(ShedError) as ei:
+        adm.admit("t", deadline_s=1e-6)
+    assert not ei.value.retriable
+    held.release()
+    # A successful admit resets the consecutive-shed streak: the next shed's
+    # guidance drops back to the small first-shed backoff.
+    held = adm.admit("t")
+    with pytest.raises(ShedError) as ei:
+        adm.admit("t")
+    assert ei.value.retry_after_s < waits[-1]
+    held.release()
+
+
+def test_admission_stats_and_shed_rate_window():
+    adm = AdmissionController(
+        [TenantPolicy("t", max_inflight=1, slo_ms=100.0)], capacity=8
+    )
+    held = adm.admit("t")
+    for _ in range(3):
+        with pytest.raises(ShedError):
+            adm.admit("t")
+    held.release()
+    assert adm.recent_shed_rate() == pytest.approx(0.75)
+    snap = adm.stats()
+    row = snap["per_tenant"]["t"]
+    assert row["admitted"] == 1 and row["shed"] == 3
+    assert snap["shed_rate"] == pytest.approx(0.75)
+
+
+# ---------------------------------------------------------------------------
+# Chaos gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _no_armed_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def test_unregistered_chaos_point_fails_loudly():
+    with pytest.raises(KeyError):
+        install_fault("engine.typo")
+    with pytest.raises(KeyError):
+        maybe_inject("engine.typo")
+
+
+def test_gate_down_means_armed_fault_is_dead(monkeypatch):
+    monkeypatch.delenv("DSL_CHAOS", raising=False)
+    assert not chaos_enabled()
+    install_fault("engine.exception", exception=RuntimeError("boom"))
+    maybe_inject("engine.exception")  # no raise: the gate is down
+
+
+def test_gate_up_fault_fires_exactly_count_times(monkeypatch):
+    monkeypatch.setenv("DSL_CHAOS", "1")
+    install_fault("engine.exception", exception=RuntimeError("boom"), count=1)
+    with pytest.raises(RuntimeError, match="boom"):
+        maybe_inject("engine.exception")
+    maybe_inject("engine.exception")  # count exhausted → dead again
+
+
+def test_inject_context_manager_disarms_on_exit(monkeypatch):
+    monkeypatch.setenv("DSL_CHAOS", "1")
+    with inject("engine.latency", delay_s=0.01):
+        t0 = time.monotonic()
+        maybe_inject("engine.latency")
+        assert time.monotonic() - t0 >= 0.008
+    t0 = time.monotonic()
+    maybe_inject("engine.latency")
+    assert time.monotonic() - t0 < 0.008
+
+
+def test_batcher_stall_injection_reaches_futures_typed(monkeypatch):
+    """An armed batcher.stall fault propagates to the queued futures as the
+    injected exception (the engine-error path), and the worker keeps
+    serving subsequent batches."""
+    monkeypatch.setenv("DSL_CHAOS", "1")
+    with MicroBatcher(lambda xs: [x * 2 for x in xs], max_batch_size=4,
+                      max_wait_ms=1.0) as mb:
+        with inject("batcher.stall", exception=RuntimeError("wedged"),
+                    count=1):
+            fut = mb.submit(1)
+            with pytest.raises(RuntimeError, match="wedged"):
+                fut.result(timeout=5)
+        assert mb.submit(2).result(timeout=5) == 4
+
+
+def test_every_chaos_point_has_rationale():
+    for point, why in CHAOS_POINTS.items():
+        assert isinstance(why, str) and len(why) > 20, point
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher drain guarantee (satellite: close() never hangs a caller)
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_close_drains_under_concurrent_clients():
+    """close() racing 8 submitting clients: every future collected before,
+    during, and after the shutdown resolves — a result or a typed
+    ShutdownError/QueueFullError — and none hangs."""
+    def run_batch(items):
+        time.sleep(0.002)  # slow engine → queue buildup at close time
+        return [x for x in items]
+
+    mb = MicroBatcher(run_batch, max_batch_size=4, max_wait_ms=1.0,
+                      max_queue=512)
+    futures = []
+    fut_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(cid):
+        i = 0
+        while not stop.is_set():
+            try:
+                f = mb.submit(cid * 100_000 + i)
+            except (QueueFullError, BatcherClosedError, ShutdownError):
+                time.sleep(0.001)
+                continue
+            with fut_lock:
+                futures.append(f)
+            i += 1
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(8)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # let the queue fill behind the slow engine
+    mb.close(wait=True)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    assert futures, "clients never got a future queued"
+    hung = unresolved = 0
+    outcomes = {"ok": 0, "shutdown": 0}
+    for f in futures:
+        try:
+            f.result(timeout=5)
+            outcomes["ok"] += 1
+        except ShutdownError:
+            outcomes["shutdown"] += 1
+        except TimeoutError:
+            hung += 1
+        if not f.done():
+            unresolved += 1
+    assert hung == 0 and unresolved == 0, (
+        f"{hung} hung / {unresolved} unresolved futures after close()"
+    )
+    assert outcomes["ok"] > 0  # in-flight work was answered, not dropped
+
+
+def test_batcher_submit_after_close_is_typed():
+    mb = MicroBatcher(lambda xs: xs, max_batch_size=2, max_wait_ms=1.0)
+    mb.close()
+    with pytest.raises(BatcherClosedError):
+        mb.submit(1)
+
+
+# ---------------------------------------------------------------------------
+# EngineProcess: the kill -9 / resume machinery, serving side
+# ---------------------------------------------------------------------------
+
+
+def test_engine_process_kill_is_typed_and_restart_recovers():
+    proc = EngineProcess(latency_s=0.0)
+    try:
+        assert proc.call([1, 2, 3]) == [1, 2, 3]
+        proc.kill()
+        with pytest.raises(HostLostError):
+            proc.call([4])
+        proc.restart()
+        assert proc.call([5]) == [5]
+        assert proc.restarts == 1
+        assert proc.alive()
+    finally:
+        proc.close()
+
+
+def test_hostloss_drill_recovers_with_zero_silent_drops():
+    """The serving-side host-loss drill: kill -9 one engine process
+    mid-serve; every admitted request completes or gets a typed rejection,
+    and the record carries a measured recovery time."""
+    record = hostloss_drill(duration_s=1.5, offered_load=80.0, capacity=24,
+                            seed=3)
+    assert record["silent_drops"] == 0
+    assert record["restarts"] == 1
+    assert record["recovery_time_s"] > 0
+    typed = sum(r["typed_errors"] for r in record["per_tenant"].values())
+    assert typed > 0  # the dead window surfaced as HostLostError, not hangs
+    assert validate_record(record) == []
+
+
+# ---------------------------------------------------------------------------
+# Scenario generator: all five scenarios, zero silent drops
+# ---------------------------------------------------------------------------
+
+
+def _siege_rig(capacity=16, work_s=0.002):
+    tenants = [
+        TenantPolicy("gold", priority=2, max_inflight=16, slo_ms=500.0),
+        TenantPolicy("free", priority=1, rate=60.0, burst=8),
+    ]
+    admission = AdmissionController(tenants, capacity=capacity)
+
+    def submit(tenant, i, *, items=1, fresh=False):
+        del fresh
+        with admission.admit(tenant, items=items, deadline_s=5.0):
+            time.sleep(work_s)
+
+    return tenants, admission, submit
+
+
+@pytest.mark.parametrize("scenario", ["burst", "skew", "slowloris"])
+def test_scenarios_emit_schema_valid_records_no_silent_drops(scenario):
+    tenants, admission, submit = _siege_rig()
+    record = run_scenario(
+        scenario, submit=submit, tenants=tenants, admission=admission,
+        duration_s=1.0, offered_load=120.0, seed=7,
+    )
+    assert record["scenario"] == scenario
+    assert record["silent_drops"] == 0
+    assert validate_record(record) == []
+    for name, row in record["per_tenant"].items():
+        assert row["sent"] > 0, name
+        assert row["silent_drops"] == 0, name
+
+
+def test_swapstorm_scenario_runs_swaps_under_load():
+    tenants, admission, submit = _siege_rig()
+    swaps = []
+    record = run_scenario(
+        "swapstorm", submit=submit, tenants=tenants, admission=admission,
+        duration_s=1.0, offered_load=80.0, swap_fn=lambda: swaps.append(1),
+        seed=5,
+    )
+    assert len(swaps) >= 2  # a swap every ~200ms over a 1s soak
+    assert record["silent_drops"] == 0
+    assert validate_record(record) == []
+
+
+def test_hostloss_scenario_requires_kill_and_restart_fns():
+    tenants, admission, submit = _siege_rig()
+    with pytest.raises(ValueError):
+        run_scenario("hostloss", submit=submit, tenants=tenants,
+                     admission=admission)
+    with pytest.raises(ValueError):
+        run_scenario("wat", submit=submit, tenants=tenants,
+                     admission=admission)
+
+
+def test_acceptance_overload_drill_in_slo_tenant_unharmed():
+    """THE acceptance drill: offered load well past what the free tenant's
+    contract (rate=30/s vs ~120/s offered) and the shared capacity sustain.
+    The in-SLO gold tenant sees zero errors and holds p99 under its SLO;
+    the over-quota free tenant is shed (typed, with backoff guidance)."""
+    tenants = [
+        TenantPolicy("gold", priority=2, max_inflight=16, slo_ms=250.0),
+        TenantPolicy("free", priority=1, rate=30.0, burst=4),
+    ]
+    admission = AdmissionController(tenants, capacity=16)
+
+    def submit(tenant, i, *, items=1, fresh=False):
+        del fresh
+        with admission.admit(tenant, items=items, deadline_s=5.0):
+            time.sleep(0.02)
+
+    record = run_scenario(
+        "skew", submit=submit, tenants=tenants, admission=admission,
+        duration_s=1.5, offered_load=240.0, seed=11,
+    )
+    gold = record["per_tenant"]["gold"]
+    free = record["per_tenant"]["free"]
+    assert gold["ok"] > 0
+    assert gold["shed"] == 0 and gold["typed_errors"] == 0
+    assert gold["silent_drops"] == 0
+    assert gold["p99_ms"] < 250.0, f"gold p99 {gold['p99_ms']}ms out of SLO"
+    assert free["shed"] > 0, "the over-quota tenant was never shed"
+    assert record["shed_rate"] > 0
+    assert record["silent_drops"] == 0
+    assert validate_record(record) == []
+
+
+@pytest.mark.slow
+def test_scenario_soak_extended():
+    """Longer soak (slow tier): every scenario at 5s with the stdlib rig —
+    the recovery and shed accounting hold over many bucket refill cycles."""
+    for scenario in ("burst", "skew", "slowloris"):
+        tenants, admission, submit = _siege_rig()
+        record = run_scenario(
+            scenario, submit=submit, tenants=tenants, admission=admission,
+            duration_s=5.0, offered_load=150.0, seed=13,
+        )
+        assert record["silent_drops"] == 0
+        assert validate_record(record) == []
+    record = hostloss_drill(duration_s=5.0, offered_load=100.0, capacity=32)
+    assert record["silent_drops"] == 0 and record["restarts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Service wiring: shed accounting, /healthz degraded, tenant telemetry
+# (stub engine: the contracts here are host-side, no jax program needed)
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    batch_buckets = (1, 8)
+    text_len_buckets = (8,)
+    token_dtype = np.int32
+    compile_count = 0
+    bucket_space = 0
+
+    def encode_text(self, batch):
+        return np.ones((batch.shape[0], 4), dtype=np.float32)
+
+    def encode_image(self, batch):
+        return np.ones((batch.shape[0], 4), dtype=np.float32)
+
+
+def _tenant_service(**kw):
+    admission = AdmissionController(
+        [
+            TenantPolicy("gold", priority=2, max_inflight=16, slo_ms=500.0),
+            TenantPolicy("free", priority=1, rate=5.0, burst=1),
+        ],
+        capacity=16,
+    )
+    service = EmbeddingService(
+        _StubEngine(), cache=None, admission=admission,
+        max_wait_ms=1.0, default_timeout=10.0, **kw,
+    )
+    return service, admission
+
+
+def test_service_sheds_typed_and_counts_separately_from_queue_full():
+    service, _ = _tenant_service()
+    with service:
+        row = np.arange(8, dtype=np.int32)
+        service.encode_text(row, tenant="free")  # burst=1 admits once
+        with pytest.raises(ShedError) as ei:
+            service.encode_text(row, tenant="free")
+        assert ei.value.reason == "rate"
+        service.encode_text(row, tenant="gold")  # other tenants unaffected
+        snap = service.stats()
+        assert snap["shed"] == 1 and snap["rejected"] == 0
+        assert snap["shed_rate"] > 0
+        assert snap["admission"]["per_tenant"]["free"]["shed"] == 1
+        # The merged snapshot stays valid against the declared serve schema.
+        assert validate_metrics(
+            {"metric": "serve_stats", **snap}, SERVE_STATS_FIELDS
+        ) == []
+
+
+def test_health_degraded_while_shedding_ok_otherwise():
+    service, _ = _tenant_service()
+    with service:
+        assert service.health()["status"] == "ok"
+        row = np.arange(8, dtype=np.int32)
+        service.encode_text(row, tenant="free")
+        with pytest.raises(ShedError):
+            service.encode_text(row, tenant="free")
+        health = service.health()
+        assert health["status"] == "degraded"
+        assert health["shed_rate"] > 0
+
+
+def test_health_degraded_while_swap_in_flight():
+    from distributed_sigmoid_loss_tpu.serve import RetrievalRouter
+
+    router = RetrievalRouter()
+    router.publish(np.eye(4, dtype=np.float32))
+    service = EmbeddingService(_StubEngine(), cache=None, index=router,
+                               max_wait_ms=1.0)
+    with service:
+        assert service.health()["status"] == "ok"
+        router.begin_swap()
+        try:
+            health = service.health()
+            assert health["status"] == "degraded"
+            assert health["swap_in_flight"] is True
+        finally:
+            router.end_swap()
+        assert service.health()["status"] == "ok"
+
+
+def test_healthz_endpoint_reports_degraded_and_metrics_carry_tenant_labels():
+    """/healthz merges {"ok": True} with the service health payload (still
+    HTTP 200 while degraded — the process IS up), and /metrics exposes the
+    per-tenant admission gauges with a tenant label."""
+    service, _ = _tenant_service()
+    with service:
+        exporter = service.start_metrics_server(port=0)
+        row = np.arange(8, dtype=np.int32)
+        service.encode_text(row, tenant="gold")
+        service.encode_text(row, tenant="free")
+        with pytest.raises(ShedError):
+            service.encode_text(row, tenant="free")
+        base = f"http://{exporter.host}:{exporter.port}"
+        with urllib.request.urlopen(f"{base}/healthz") as resp:
+            assert resp.status == 200
+            health = json.loads(resp.read())
+        assert health["ok"] is True
+        assert health["status"] == "degraded"
+        with urllib.request.urlopen(f"{base}/metrics") as resp:
+            text = resp.read().decode()
+        assert 'tenant="free"' in text
+        assert 'tenant="gold"' in text
+
+
+def test_chaos_and_dsl_chaos_not_set_in_test_env():
+    """The suite itself must run with the gate DOWN by default — faults in
+    these tests are armed via monkeypatch; a leaked DSL_CHAOS=1 would mean
+    production paths run with injection live."""
+    assert os.environ.get("DSL_CHAOS", "") != "1" or not CHAOS_POINTS
