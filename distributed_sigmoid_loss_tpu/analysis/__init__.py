@@ -18,6 +18,12 @@ Three halves, one Finding stream:
 - :mod:`.repo_lint` is an AST pass over the package + bench.py enforcing
   repo invariants (trace-time mutable globals, bench compile-shield
   coverage, doc staleness, slow markers, bench record schema).
+- :mod:`.lock_flow` ("graftguard") is the concurrency half: guarded-by
+  inference over every lock-owning class (unguarded writes, un-looped
+  ``Condition.wait``, blocking calls under a lock, orphan threads), the
+  static lock-acquisition graph with cycle detection, and the
+  ``repo-lockwatch-gate`` proof that :mod:`..obs.lockwatch`'s runtime
+  witness is dead in prod and every lock routes through it.
 
 Run via ``python -m distributed_sigmoid_loss_tpu lint`` (exit 1 on findings,
 ``--json``, per-rule ``--disable``, ``--full-product`` for the
@@ -31,6 +37,10 @@ docs/ANALYSIS.md.
 from __future__ import annotations
 
 from distributed_sigmoid_loss_tpu.analysis.findings import Finding  # noqa: F401
+from distributed_sigmoid_loss_tpu.analysis.lock_flow import (  # noqa: F401
+    LOCK_RULES,
+    run_lock_flow,
+)
 from distributed_sigmoid_loss_tpu.analysis.repo_lint import (  # noqa: F401
     REPO_RULES,
     run_repo_lint,
@@ -40,10 +50,12 @@ __all__ = [
     "Finding",
     "ALL_RULES",
     "REPO_RULES",
+    "LOCK_RULES",
     "JAXPR_RULES",
     "CONFIG_RULES",
     "META_RULES",
     "run_lint",
+    "run_lock_flow",
     "load_lint_baseline",
     "apply_lint_baseline",
 ]
@@ -72,7 +84,7 @@ CONFIG_RULES = ("config-space-drift",)
 # Rules about the lint run itself: a --baseline entry that no longer fires.
 META_RULES = ("lint-stale-suppression",)
 
-ALL_RULES = REPO_RULES + JAXPR_RULES + CONFIG_RULES + META_RULES
+ALL_RULES = REPO_RULES + LOCK_RULES + JAXPR_RULES + CONFIG_RULES + META_RULES
 
 
 def run_lint(
@@ -81,8 +93,9 @@ def run_lint(
     n_devices: int | None = None,
     full_product: bool = False,
 ) -> list[Finding]:
-    """Run the repo linter and (unless ``jaxpr=False``) the config-space
-    drift check plus the jaxpr auditor over the sampled step-config product.
+    """Run the repo linter, the lock-flow analyzer, and (unless
+    ``jaxpr=False``) the config-space drift check plus the jaxpr auditor
+    over the sampled step-config product.
 
     ``disabled``: rule ids to drop from the result. ``n_devices``: virtual
     mesh size for the auditor (default: min(8, available)).
@@ -92,6 +105,7 @@ def run_lint(
     """
     disabled = set(disabled)
     findings = run_repo_lint(disabled=disabled)
+    findings.extend(run_lock_flow(disabled=disabled))
     if jaxpr:
         # Imported lazily: the AST half must stay usable (and fast) in
         # processes that never initialize jax.

@@ -2177,10 +2177,11 @@ def _obs_summarize(args) -> int:
 
 
 def cmd_lint(args) -> int:
-    """Run graftlint: the repo-invariant AST linter plus (default) the
-    config-space drift check and the jaxpr collective/dtype/dataflow auditor
-    over the sampled step-config product on an emulated CPU mesh. Exit 0 =
-    clean, 1 = findings, 2 = usage error.
+    """Run graftlint: the repo-invariant AST linter, the graftguard
+    lock-discipline analyzer (guarded-by + lock-order + lockwatch gate),
+    plus (default) the config-space drift check and the jaxpr
+    collective/dtype/dataflow auditor over the sampled step-config product
+    on an emulated CPU mesh. Exit 0 = clean, 1 = findings, 2 = usage error.
 
     Rule catalog + allowlist policy: docs/ANALYSIS.md. The same entry points
     run inside tests/test_analysis.py and the __graft_entry__ dryrun, so a

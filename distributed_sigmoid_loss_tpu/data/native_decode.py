@@ -30,6 +30,8 @@ import numpy as np
 from distributed_sigmoid_loss_tpu.data.native_loader import build_shared_lib
 from distributed_sigmoid_loss_tpu.data.workers import default_data_workers
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = ["native_decode_available", "decode_batch", "default_decode_threads"]
 
 
@@ -56,7 +58,7 @@ _NATIVE_DIR = os.path.join(
 _SRC = os.path.join(_NATIVE_DIR, "jpeg_decode.cc")
 _LIB = os.path.join(_NATIVE_DIR, "libdsl_jpeg.so")
 
-_build_lock = threading.Lock()
+_build_lock = named_lock("data.native_decode._build_lock")
 _lib = None
 _lib_failed = False
 

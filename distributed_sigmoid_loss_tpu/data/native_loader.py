@@ -30,6 +30,8 @@ import numpy as np
 from distributed_sigmoid_loss_tpu.data.workers import default_data_workers
 from distributed_sigmoid_loss_tpu.utils.config import SigLIPConfig
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = [
     "build_shared_lib",
     "native_available",
@@ -43,7 +45,7 @@ _NATIVE_DIR = os.path.join(
 )
 _SRC = os.path.join(_NATIVE_DIR, "dataloader.cc")
 _LIB = os.path.join(_NATIVE_DIR, "libdsl_data.so")
-_build_lock = threading.Lock()
+_build_lock = named_lock("data.native_loader._build_lock")
 _lib = None
 
 
@@ -206,8 +208,8 @@ class NativeSyntheticImageText:
         # consumer blocked inside the native call (dsl_pipeline_stop, taken
         # WITHOUT this lock), then frees the engine under the lock — so destroy
         # can never race a thread (e.g. the loader.prefetch worker) mid-call.
-        self._iter_lock = threading.Lock()
-        self._close_lock = threading.Lock()  # serializes concurrent close()rs
+        self._iter_lock = named_lock("data.native_loader.NativeSyntheticImageText._iter_lock")
+        self._close_lock = named_lock("data.native_loader.NativeSyntheticImageText._close_lock")  # serializes concurrent close()rs
 
     def __iter__(self) -> Iterator[dict]:
         while True:

@@ -28,6 +28,12 @@ evidence attached, chip or no chip:
 - :mod:`.telemetry` — live pull-based metrics: the OpenMetrics-style
   ``/metrics`` exporter the serving stack mounts, plus the atomic-rename
   telemetry file the train loop writes under ``--obs-dir``.
+- :mod:`.lockwatch` — graftguard's runtime half: the ``named_lock`` factory
+  every host-stack lock routes through, a Goodlock-style potential-deadlock
+  witness recording the runtime lock-acquisition graph when
+  ``DSL_LOCKWATCH=1`` (raw ``threading.Lock`` otherwise — proven dead in
+  prod by the ``repo-lockwatch-gate`` lint), and the ``WATCHED_LOCKS``
+  inventory docs/SERVING.md's threading model is sourced from.
 
 Import discipline: this package must stay importable without initializing
 jax (the linter and the CLI's argparse layer import the schema); anything
@@ -46,6 +52,16 @@ from distributed_sigmoid_loss_tpu.obs.metrics_schema import (  # noqa: F401
     TRAIN_METRICS_FIELDS,
     TRAIN_METRICS_PREFIXES,
     validate_metrics,
+)
+from distributed_sigmoid_loss_tpu.obs.lockwatch import (  # noqa: F401
+    WATCHED_LOCKS,
+    WitnessGraph,
+    lockwatch_enabled,
+    named_condition,
+    named_lock,
+    named_rlock,
+    watched_lock,
+    witness,
 )
 from distributed_sigmoid_loss_tpu.obs.ledger import (  # noqa: F401
     append_record,
@@ -93,4 +109,12 @@ __all__ = [
     "TelemetryExporter",
     "render_openmetrics",
     "write_telemetry_file",
+    "WATCHED_LOCKS",
+    "WitnessGraph",
+    "lockwatch_enabled",
+    "named_lock",
+    "named_rlock",
+    "named_condition",
+    "watched_lock",
+    "witness",
 ]

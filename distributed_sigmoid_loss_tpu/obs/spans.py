@@ -34,6 +34,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Iterable
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = [
     "Span",
     "SpanRecorder",
@@ -111,7 +113,7 @@ class SpanRecorder:
         self.capacity = capacity
         self.enabled = enabled
         self._spans: deque[Span] = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.spans.SpanRecorder._lock")
         self.dropped = 0  # spans evicted by the ring (total ever)
 
     # -- recording -----------------------------------------------------------

@@ -38,6 +38,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Iterable, Mapping
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = [
     "render_openmetrics",
     "TelemetryExporter",
@@ -185,7 +187,7 @@ class TelemetryExporter:
         self._requested_port = port
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.telemetry.TelemetryExporter._lock")
         self._cached: bytes = b""
         self._cached_at = 0.0
         self.scrapes = 0

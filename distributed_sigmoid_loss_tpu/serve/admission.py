@@ -43,6 +43,8 @@ from dataclasses import dataclass, field
 
 from distributed_sigmoid_loss_tpu.utils.logging import LatencyWindow
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = [
     "AdmissionController",
     "AdmissionTicket",
@@ -178,7 +180,7 @@ class AdmissionController:
         self.shed_window_s = float(shed_window_s)
         self._policies = {p.name: p for p in policies}
         self._default = default_policy or TenantPolicy(DEFAULT_TENANT)
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.admission.AdmissionController._lock")
         self._states: dict[str, _TenantState] = {}
         self._total_inflight = 0
         self._decisions: deque = deque(maxlen=65536)  # (ts, was_shed)

@@ -40,6 +40,8 @@ from typing import Any, Callable, Sequence
 from distributed_sigmoid_loss_tpu.serve.siege import maybe_inject
 from distributed_sigmoid_loss_tpu.utils.logging import LatencyWindow
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 BATCH_STAGES = ("queue_wait", "assembly", "device", "reply")
 
 __all__ = [
@@ -124,7 +126,7 @@ class MicroBatcher:
         self._spans = spans  # SpanRecorder or None (obs/spans.py)
         self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
         self._closed = False
-        self._hist_lock = threading.Lock()
+        self._hist_lock = named_lock("serve.batcher.MicroBatcher._hist_lock")
         self._batch_sizes: Counter[int] = Counter()
         # Small windows: a batcher's stage stats cover recent traffic, and
         # four windows per batcher must stay cheap.

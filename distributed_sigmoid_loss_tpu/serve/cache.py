@@ -21,6 +21,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = ["EmbeddingCache", "content_key"]
 
 
@@ -61,7 +63,7 @@ class EmbeddingCache:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._data: OrderedDict[str, np.ndarray] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.cache.EmbeddingCache._lock")
         self.hits = 0
         self.misses = 0
         self.evictions = 0

@@ -36,6 +36,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
 from distributed_sigmoid_loss_tpu.serve.siege import maybe_inject
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = ["InferenceEngine"]
 
 
@@ -91,7 +93,7 @@ class InferenceEngine:
             "text": jax.jit(encode_text_fn),
         }
         self._compiled: set[tuple] = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.engine.InferenceEngine._lock")
 
     @classmethod
     def from_model(cls, model, params, **kw):

@@ -23,6 +23,8 @@ import threading
 
 import numpy as np
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = ["RetrievalIndex"]
 
 
@@ -46,7 +48,7 @@ class RetrievalIndex:
         self._blocks: list[np.ndarray] = []
         self._ids: list[np.ndarray] = []
         self._size = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.index.RetrievalIndex._lock")
 
     def __len__(self) -> int:
         with self._lock:

@@ -39,6 +39,8 @@ from distributed_sigmoid_loss_tpu.serve.index import RetrievalIndex
 from distributed_sigmoid_loss_tpu.serve.shard_index import ShardedIndex
 from distributed_sigmoid_loss_tpu.utils.logging import LatencyWindow, MetricsLogger
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = ["EmbeddingService", "RequestTimeoutError", "RetrievalRouter"]
 
 
@@ -117,9 +119,9 @@ class RetrievalRouter:
         self.query_buckets = tuple(query_buckets)
         self.spans = spans
         self._current: _IndexVersion | None = None
-        self._publish_lock = threading.Lock()
+        self._publish_lock = named_lock("serve.service.RetrievalRouter._publish_lock")
         self._versions = 0
-        self._stats_lock = threading.Lock()
+        self._stats_lock = named_lock("serve.service.RetrievalRouter._stats_lock")
         self._swap_count = 0
         self._swaps_in_flight = 0
         self._swap_window = LatencyWindow(1024)
@@ -358,7 +360,7 @@ class EmbeddingService:
             ),
         }
         self._latency = LatencyWindow()
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.service.EmbeddingService._lock")
         self._requests = 0
         self._items = 0
         self._rejected = 0

@@ -48,6 +48,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_sigmoid_loss_tpu.eval.retrieval import merge_topk
 from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = ["ShardedIndex"]
 
 
@@ -137,7 +139,7 @@ class ShardedIndex:
         self._rows = jax.device_put(rows, sharding)
         self._ids = jax.device_put(ids.astype(np.int32), sharding)
         self._compiled: set[tuple[int, int]] = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.shard_index.ShardedIndex._lock")
 
     def __len__(self) -> int:
         return self.size

@@ -45,6 +45,8 @@ from distributed_sigmoid_loss_tpu.serve.admission import (
 )
 from distributed_sigmoid_loss_tpu.utils.logging import LatencyWindow
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = [
     "CHAOS_POINTS",
     "SCENARIOS",
@@ -89,7 +91,7 @@ CHAOS_POINTS = {
 # (allowlisted in analysis/repo_lint.py): tests and scenario drivers arm
 # faults cross-thread, and the production read path must stay one dict probe.
 _INJECTORS: dict = {}
-_INJECT_LOCK = threading.Lock()
+_INJECT_LOCK = named_lock("serve.siege._INJECT_LOCK")
 
 
 def chaos_enabled() -> bool:
@@ -228,7 +230,7 @@ class EngineProcess:
         self._worker = worker or _echo_worker
         self._ctx_name = ctx
         self._latency_s = latency_s
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.siege.EngineProcess._lock")
         self.restarts = 0
         self._start()
 
@@ -388,7 +390,7 @@ def run_scenario(
     tallies = {p.name: _TenantTally() for p in tenants}
     windows = {p.name: LatencyWindow(8192) for p in tenants}
     overall_window = LatencyWindow(8192)
-    tally_lock = threading.Lock()
+    tally_lock = named_lock("serve.siege.run_scenario.tally_lock")
     stop = threading.Event()
     t_start = time.monotonic()
     kill_at = {"t": None}

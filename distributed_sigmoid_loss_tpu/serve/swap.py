@@ -36,6 +36,8 @@ from distributed_sigmoid_loss_tpu.serve.engine import InferenceEngine
 from distributed_sigmoid_loss_tpu.serve.service import RetrievalRouter
 from distributed_sigmoid_loss_tpu.serve.siege import maybe_inject
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = ["SwapController"]
 
 
@@ -52,7 +54,7 @@ class SwapController:
     def __init__(self, engine: InferenceEngine, router: RetrievalRouter):
         self.engine = engine
         self.router = router
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.swap.SwapController._lock")
 
     def swap(self, *, params=None, embeddings=None, ids=None) -> int:
         """Publish a new serving version; returns its version number.

@@ -13,6 +13,8 @@ import time
 from collections import deque
 from typing import IO, Mapping
 
+from distributed_sigmoid_loss_tpu.obs.lockwatch import named_lock
+
 __all__ = ["MetricsLogger", "LatencyWindow"]
 
 
@@ -27,7 +29,7 @@ class LatencyWindow:
 
     def __init__(self, maxlen: int = 8192):
         self._samples: deque[float] = deque(maxlen=maxlen)
-        self._lock = threading.Lock()
+        self._lock = named_lock("utils.logging.LatencyWindow._lock")
         self.count = 0  # total ever recorded (not just retained)
 
     def record(self, seconds: float) -> None:

@@ -1,0 +1,110 @@
+#!/bin/bash
+# Round-15 chip measurement queue — the graftguard round: every host-tier
+# lock is now named, registered, and witnessable (obs/lockwatch.py), so
+# this round's serving entries run the degradation soaks with the
+# potential-deadlock witness armed where it is free to do so, and the
+# chip-free pre-flight now includes the full lock-discipline lint pass:
+#   nohup bash docs/round15_chip_queue.sh > /tmp/r15queue.log 2>&1 &
+#
+# PERF-STREAM DEBT NOTE (carry-forward): the last driver-verified headline
+# is STILL round 3's 761.74 pairs/s/chip (vs_baseline 0.692) — rounds
+# 4/5 recorded no-backend outages and the round-10..14 pallas, _32k_equiv
+# and serving-tier recipes have no ledgered chip numbers yet. Twelve
+# rounds of program-level wins are stacked behind one verified
+# measurement; landing chip numbers remains THE debt, and every entry
+# below lands in LEDGER.jsonl with status + fingerprint either way.
+#
+# Same recovery-waiting discipline as rounds 5-14: one bounded probe per
+# cycle until the tunnel answers, then measurements cheapest-first. NEVER
+# signal a running bench process (SIGTERM mid-XLA-compile wedges the tunnel
+# — docs/PERF.md postmortems); fresh-compile configs ride the detached
+# compile shield automatically.
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-14 queue.
+while pgrep -f round14_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+set -x
+# -1. Chip-free pre-flight (runs even if the probe loop exhausted): the
+#     full-product lint pass — which now includes the six graftguard lock
+#     rules, so an unguarded write or an ungated named_lock can never
+#     reach a chip run — the proxy regression gate, and the CPU-side
+#     graftsiege acceptance soaks. The skew soak runs with the lockwatch
+#     witness ARMED: the stdlib host stack pays only wrapper overhead,
+#     and a lock-order inversion anywhere in the admission/batcher/swap
+#     path fails the entry before any chip time is spent. The remaining
+#     scenarios run unwatched so their degradation numbers stay
+#     comparable with the round-14 ledger entries.
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu lint --full-product
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu obs regress
+DSL_LOCKWATCH=1 JAX_PLATFORMS=cpu \
+  python -m distributed_sigmoid_loss_tpu serve-bench \
+  --scenario skew --duration-s 20 --offered-load 400 --capacity 64 \
+  --tenants 'gold:prio=2,quota=24,slo=500;free:prio=1,rate=40,quota=8'
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu serve-bench \
+  --scenario hostloss --duration-s 10 --offered-load 120 --capacity 32
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu serve-bench \
+  --scenario swapstorm --duration-s 20 --offered-load 200
+
+# -0.5. The lockwatch soak: the threaded tier-1 suites as a witness run
+#     (conftest's sessionfinish gate exits non-zero on any witnessed
+#     lock-order cycle, even if nothing hung). Chip-free, ~2 min.
+DSL_LOCKWATCH=1 JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_serve.py tests/test_siege.py tests/test_distindex.py \
+  tests/test_data_pipeline.py tests/test_lockwatch.py -q -m 'not slow'
+
+# 0. Headline anchor first (cached compiles) — the perf stream needs ANY
+#    driver-verified train number this round; its ledger entry carries the
+#    device fingerprint that pins it.
+python bench.py
+
+# 1. The carried headline recipe (bf16 accum + mu + save_hot remat).
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot
+
+# 2. Serving soaks ON THE CHIP HOST: real engine + warmed buckets under
+#    overload — the zero-recompile gate must hold while shedding and
+#    swapping (compile_count == bucket_space or exit 1). Degradation
+#    records join the train numbers in the same ledger. The skew entry
+#    repeats with the witness armed: the host tier is stdlib threading,
+#    so the wrapper cost stays off the XLA path, and the pair quantifies
+#    any watch overhead directly in the ledger.
+python bench.py 1 1 tiny --serve-bench --serve-scenario skew
+DSL_LOCKWATCH=1 python bench.py 1 1 tiny --serve-bench \
+  --serve-scenario skew --metric-suffix _lockwatch
+python bench.py 1 1 tiny --serve-bench --serve-scenario swapstorm
+python bench.py 1 1 tiny --serve-bench --serve-scenario hostloss
+
+# 3. Round-13/14 debt: the serving-tier A/Bs that still have no chip
+#    numbers.
+python bench.py 1 1 tiny --serve-bench --index-tier ann --swap-every 64
+python bench.py 1 1 tiny --serve-bench
+
+# 4. Round-10..12 debt, cheapest first: pallas loss engagement + the
+#    32k-equiv ladder anchor.
+python bench.py 256 30 b16 --use-pallas
+python bench.py 1024 30 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --metric-suffix _32k_equiv
+
+# 5. Post-run trajectory render for the round summary.
+python -m distributed_sigmoid_loss_tpu obs ledger \
+  --metric siglip_vitb16_train_pairs_per_sec_per_chip
+python -m distributed_sigmoid_loss_tpu obs ledger --metric serve_siege

@@ -69,6 +69,7 @@ _STANDARD_MODULES = {
     "test_distindex",
     "test_distributed_parity",
     "test_graftledger",
+    "test_lockwatch",
     "test_obs",
     "test_pipeline",
     "test_serve",
@@ -86,6 +87,34 @@ def pytest_collection_modifyitems(config, items):
         name = mod.__name__.rsplit(".", 1)[-1] if mod else ""
         if name in _STANDARD_MODULES or item.get_closest_marker("smoke"):
             item.add_marker(pytest.mark.standard)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # graftguard witness gate: when the run was armed with DSL_LOCKWATCH=1,
+    # every named_lock in the threaded suites recorded its acquisition order
+    # into the process-global witness — a cycle here is a potential deadlock
+    # one of the suites exercised, even if no run ever hung. This turns the
+    # existing test_serve/test_siege/test_distindex/test_data_pipeline
+    # traffic into witness runs for free.
+    if os.environ.get("DSL_LOCKWATCH") != "1":
+        return
+    import pytest
+
+    from distributed_sigmoid_loss_tpu.obs.lockwatch import witness
+
+    cycles = witness().cycles()
+    if cycles:
+        session.exitstatus = 1
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        lines = [f"lockwatch witness cycle: {' -> '.join(c + (c[0],))}"
+                 for c in cycles]
+        if tr is not None:
+            for ln in lines:
+                tr.write_line(ln, red=True)
+        raise pytest.UsageError(
+            "DSL_LOCKWATCH witnessed potential deadlock(s):\n"
+            + "\n".join(lines)
+        )
 
 
 def write_tar_shard(path, items, fmt="PNG", quality=None):

@@ -411,20 +411,25 @@ def test_collective_order_trips_on_varying_pred_and_replicated_passes():
 def test_rule_catalogs_agree():
     from distributed_sigmoid_loss_tpu.analysis import (
         CONFIG_RULES,
+        LOCK_RULES,
         META_RULES,
         shard_flow,
     )
     from distributed_sigmoid_loss_tpu.analysis.config_space import (
         CONFIG_SPACE_RULES,
     )
+    from distributed_sigmoid_loss_tpu.analysis.lock_flow import (
+        LOCK_RULES as LOCK_FLOW_RULES,
+    )
 
     assert tuple(JAXPR_RULES) == (
         tuple(jaxpr_audit.JAXPR_RULES) + tuple(shard_flow.SHARD_FLOW_RULES)
     )
     assert tuple(CONFIG_RULES) == tuple(CONFIG_SPACE_RULES)
+    assert tuple(LOCK_RULES) == tuple(LOCK_FLOW_RULES)
     assert (
-        set(repo_lint.REPO_RULES) | set(JAXPR_RULES) | set(CONFIG_RULES)
-        | set(META_RULES)
+        set(repo_lint.REPO_RULES) | set(LOCK_RULES) | set(JAXPR_RULES)
+        | set(CONFIG_RULES) | set(META_RULES)
     ) == set(ALL_RULES)
 
 
@@ -860,3 +865,326 @@ def test_chaos_gate_trips_on_stale_registry_row():
     )
     assert [f.subject for f in findings] == ["serve/siege.py::engine.latency"]
     assert "stale" in findings[0].detail
+
+
+# ---------------------------------------------------------------------------
+# graftguard (analysis/lock_flow.py): each lock-* rule falsified on a
+# known-bad fixture, green on the shipped tree
+# ---------------------------------------------------------------------------
+
+from distributed_sigmoid_loss_tpu.analysis import lock_flow  # noqa: E402
+
+
+def test_lock_flow_green_on_shipped_tree():
+    findings = lock_flow.run_lock_flow()
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_unguarded_write_trips_and_init_and_reads_exempt():
+    src = (
+        "import threading\n"
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"           # construction: exempt
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"      # defines the guarded set
+        "    def reset(self):\n"
+        "        self._n = 0\n"           # unguarded write: trips
+        "    def peek(self):\n"
+        "        return self._n\n"        # plain read: NOT flagged
+    )
+    findings = lock_flow.analyze_lock_flow(sources={"fake/mod.py": src})
+    assert _rules_of(findings) == ["lock-unguarded-write"]
+    assert [f.subject for f in findings] == ["fake/mod.py::Counter._n"]
+    fixed = src.replace(
+        "    def reset(self):\n        self._n = 0\n",
+        "    def reset(self):\n        with self._lock:\n"
+        "            self._n = 0\n",
+    )
+    assert lock_flow.analyze_lock_flow(sources={"fake/mod.py": fixed}) == []
+
+
+def test_unguarded_mutating_method_call_trips():
+    """Compound RMW through a mutating method (append/pop/...) outside the
+    lock is the same torn-update class as a bare assignment."""
+    src = (
+        "import threading\n"
+        "class Log:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._rows = []\n"
+        "    def add(self, r):\n"
+        "        with self._lock:\n"
+        "            self._rows.append(r)\n"
+        "    def drop(self):\n"
+        "        self._rows.pop()\n"
+    )
+    findings = lock_flow.analyze_lock_flow(sources={"fake/mod.py": src})
+    assert [(f.rule, f.subject) for f in findings] == [
+        ("lock-unguarded-write", "fake/mod.py::Log._rows")
+    ]
+
+
+def test_wait_no_loop_trips_and_while_wrapped_is_clean():
+    src = (
+        "import threading\n"
+        "class Waiter:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self.ready = False\n"
+        "    def bad(self):\n"
+        "        with self._cv:\n"
+        "            if not self.ready:\n"
+        "                self._cv.wait()\n"
+        "    def good(self):\n"
+        "        with self._cv:\n"
+        "            while not self.ready:\n"
+        "                self._cv.wait()\n"
+    )
+    findings = lock_flow.analyze_lock_flow(sources={"fake/mod.py": src})
+    assert [(f.rule, f.subject) for f in findings] == [
+        ("lock-wait-no-loop", "fake/mod.py::Waiter.bad")
+    ]
+
+
+def test_blocking_hold_trips_and_str_join_dict_get_exempt():
+    src = (
+        "import threading\n"
+        "class Holder:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._futs = []\n"
+        "        self.cfg = {}\n"
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            for f in self._futs:\n"
+        "                f.result()\n"          # blocking under lock
+        "    def ok(self):\n"
+        "        with self._lock:\n"
+        "            s = ','.join(['a'])\n"     # str.join: exempt
+        "            v = self.cfg.get('k')\n"   # dict.get: exempt
+        "            return s, v\n"
+    )
+    findings = lock_flow.analyze_lock_flow(sources={"fake/mod.py": src})
+    assert [(f.rule, f.subject) for f in findings] == [
+        ("lock-blocking-hold", "fake/mod.py::Holder.flush")
+    ]
+    # queue-ish receivers DO trip: the q.get() convoy class.
+    qsrc = src.replace(
+        "            for f in self._futs:\n                f.result()\n",
+        "            item = self.work_q.get()\n",
+    )
+    findings = lock_flow.analyze_lock_flow(sources={"fake/mod.py": qsrc})
+    assert _rules_of(findings) == ["lock-blocking-hold"]
+
+
+def test_orphan_thread_trips_and_joined_is_clean():
+    src = (
+        "import threading\n"
+        "class Runner:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n"
+        "    def _run(self):\n"
+        "        pass\n"
+    )
+    findings = lock_flow.analyze_lock_flow(sources={"fake/mod.py": src})
+    assert [(f.rule, f.subject) for f in findings] == [
+        ("lock-orphan-thread", "fake/mod.py::Runner._t")
+    ]
+    fixed = src + "    def close(self):\n        self._t.join()\n"
+    assert lock_flow.analyze_lock_flow(sources={"fake/mod.py": fixed}) == []
+
+
+def test_order_cycle_trips_on_seeded_inversion():
+    src = (
+        "import threading\n"
+        "LA = threading.Lock()\n"
+        "LB = threading.Lock()\n"
+        "def one():\n"
+        "    with LA:\n"
+        "        with LB:\n"
+        "            pass\n"
+        "def two():\n"
+        "    with LB:\n"
+        "        with LA:\n"
+        "            pass\n"
+    )
+    findings = lock_flow.check_lock_order(sources={"fake/mod.py": src})
+    assert _rules_of(findings) == ["lock-order-cycle"]
+    assert "fake/mod.py::LA" in findings[0].subject
+    assert "fake/mod.py::LB" in findings[0].subject
+    # one consistent direction: an edge, no cycle
+    acyclic = src.replace(
+        "def two():\n    with LB:\n        with LA:\n            pass\n", ""
+    )
+    assert lock_flow.check_lock_order(
+        sources={"fake/mod.py": acyclic}
+    ) == []
+    assert lock_flow.lock_order_edges(sources={"fake/mod.py": acyclic}) == {
+        ("fake/mod.py::LA", "fake/mod.py::LB")
+    }
+
+
+def test_lock_allowlist_suppresses_and_stale_entry_trips():
+    f = Finding("lock-blocking-hold", "fake/mod.py::C.m", "d")
+    kept = lock_flow._apply_allowlist(
+        [f], {"lock-blocking-hold::fake/mod.py::C.m": "rationale"}
+    )
+    assert kept == []
+    stale = lock_flow._apply_allowlist(
+        [], {"lock-blocking-hold::fake/mod.py::C.m": "rationale"}
+    )
+    assert [(s.rule, s.subject) for s in stale] == [
+        ("lock-blocking-hold", "fake/mod.py::C.m")
+    ]
+    assert "stale" in stale[0].detail
+
+
+# ---------------------------------------------------------------------------
+# repo-lockwatch-gate: the witness provably dead in prod
+# ---------------------------------------------------------------------------
+
+_GOOD_LOCKWATCH_FIXTURE = '''
+import os
+import threading
+
+WATCHED_LOCKS = {"serve.widget._lock": "guards widget internal state"}
+
+def lockwatch_enabled():
+    return os.environ.get("DSL_LOCKWATCH", "") == "1"
+
+def _factory(name, kind):
+    if name not in WATCHED_LOCKS:
+        raise KeyError(name)
+    if lockwatch_enabled():
+        return _watched(name, kind)
+    return kind()
+
+def named_lock(name):
+    if name not in WATCHED_LOCKS:
+        raise KeyError(name)
+    if lockwatch_enabled():
+        return _watched(name)
+    return threading.Lock()
+
+def named_rlock(name):
+    if name not in WATCHED_LOCKS:
+        raise KeyError(name)
+    if lockwatch_enabled():
+        return _watched(name)
+    return threading.RLock()
+
+def named_condition(name):
+    if name not in WATCHED_LOCKS:
+        raise KeyError(name)
+    if lockwatch_enabled():
+        return _watched(name)
+    return threading.Condition()
+'''
+
+_GOOD_GATE_SOURCES = {
+    "serve/widget.py": 'lock = named_lock("serve.widget._lock")\n',
+}
+
+
+def test_lockwatch_gate_green_on_minimal_fixture_and_shipped_tree():
+    assert lock_flow.check_lockwatch_gate(
+        lockwatch_source=_GOOD_LOCKWATCH_FIXTURE,
+        sources=_GOOD_GATE_SOURCES, raw_allowlist={},
+    ) == []
+    findings = lock_flow.check_lockwatch_gate()
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_lockwatch_gate_trips_on_ungated_factory():
+    ungated = _GOOD_LOCKWATCH_FIXTURE.replace(
+        "def named_lock(name):\n"
+        "    if name not in WATCHED_LOCKS:\n"
+        "        raise KeyError(name)\n"
+        "    if lockwatch_enabled():\n"
+        "        return _watched(name)\n"
+        "    return threading.Lock()\n",
+        "def named_lock(name):\n"
+        "    return _watched(name)\n",
+    )
+    findings = lock_flow.check_lockwatch_gate(
+        lockwatch_source=ungated,
+        sources=_GOOD_GATE_SOURCES, raw_allowlist={},
+    )
+    assert [f.subject for f in findings] == ["obs/lockwatch.py::named_lock"]
+
+
+def test_lockwatch_gate_trips_when_gate_ignores_env_hook():
+    wrong = _GOOD_LOCKWATCH_FIXTURE.replace(
+        '"DSL_LOCKWATCH"', '"OTHER_VAR"'
+    )
+    findings = lock_flow.check_lockwatch_gate(
+        lockwatch_source=wrong,
+        sources=_GOOD_GATE_SOURCES, raw_allowlist={},
+    )
+    assert [f.subject for f in findings] == [
+        "obs/lockwatch.py::lockwatch_enabled"
+    ]
+
+
+def test_lockwatch_gate_trips_on_empty_rationale():
+    no_why = _GOOD_LOCKWATCH_FIXTURE.replace(
+        '"guards widget internal state"', '""'
+    )
+    findings = lock_flow.check_lockwatch_gate(
+        lockwatch_source=no_why,
+        sources=_GOOD_GATE_SOURCES, raw_allowlist={},
+    )
+    assert [f.subject for f in findings] == [
+        "obs/lockwatch.py::serve.widget._lock"
+    ]
+
+
+def test_lockwatch_gate_trips_on_unregistered_and_computed_sites():
+    bad = {
+        "serve/widget.py": 'lock = named_lock("serve.widget._lock")\n'
+                           'other = named_lock("serve.widget.ghost")\n',
+        "serve/gadget.py": "lock = named_lock(computed)\n",
+    }
+    findings = lock_flow.check_lockwatch_gate(
+        lockwatch_source=_GOOD_LOCKWATCH_FIXTURE,
+        sources=bad, raw_allowlist={},
+    )
+    assert sorted(f.subject for f in findings) == [
+        "serve/gadget.py::<module>",
+        "serve/widget.py::serve.widget.ghost",
+    ]
+
+
+def test_lockwatch_gate_trips_on_stale_registry_row():
+    findings = lock_flow.check_lockwatch_gate(
+        lockwatch_source=_GOOD_LOCKWATCH_FIXTURE,
+        sources={"serve/widget.py": "x = 1\n"}, raw_allowlist={},
+    )
+    assert [f.subject for f in findings] == [
+        "obs/lockwatch.py::serve.widget._lock"
+    ]
+    assert "stale" in findings[0].detail
+
+
+def test_lockwatch_gate_trips_on_raw_lock_and_allowlist_clears():
+    src = {
+        "serve/widget.py": 'lock = named_lock("serve.widget._lock")\n'
+                           "import threading\n"
+                           "raw = threading.Lock()\n",
+    }
+    findings = lock_flow.check_lockwatch_gate(
+        lockwatch_source=_GOOD_LOCKWATCH_FIXTURE,
+        sources=src, raw_allowlist={},
+    )
+    assert [(f.rule, f.subject) for f in findings] == [
+        ("repo-lockwatch-gate", "serve/widget.py::<module>")
+    ]
+    assert lock_flow.check_lockwatch_gate(
+        lockwatch_source=_GOOD_LOCKWATCH_FIXTURE,
+        sources=src,
+        raw_allowlist={"serve/widget.py::<module>": "bootstrap lock"},
+    ) == []
