@@ -324,6 +324,16 @@ _SHIELD_EXEMPT_FLAGS = {
                       "shedding, and fault injection never change the "
                       "compiled engine programs (the compile gate holds "
                       "under chaos)",
+    "dcn_slices": "only meaningful with --grad-compression, which is "
+                  "already a shield trigger (enforced: refused without it)",
+    "dcn_budget_mbps": "only meaningful with --grad-compression adaptive "
+                       "(shield trigger); host-side controller budget — the "
+                       "scheme table is a donated operand, recompile-free "
+                       "by contract",
+    "topk_frac": "only meaningful with --grad-compression (shield trigger); "
+                 "its k does change the compiled program, but never without "
+                 "the compression flag that already routes through the "
+                 "shield (the --moe-k pattern)",
 }
 
 
@@ -370,6 +380,10 @@ def _fresh_compile_config(args) -> bool:
         # sharded tier's fan-out program) — fresh compiles, none of them in
         # the headline warm cache.
         or args.serve_bench
+        # Compressed DCN sync rebuilds the whole step inside a hybrid
+        # (dcn, dp) shard_map (quantize/pack + all-gather + EF update) —
+        # never in the warm single-axis headline cache.
+        or bool(args.grad_compression)
         or args.use_pallas
         or args.variant != "ring"
         or args.loss_family != "sigmoid"
@@ -1306,6 +1320,33 @@ def main():
                          "full-precision VJP backward — the int8 training "
                          "track's headline lever (docs/PERF.md roofline "
                          "rationale); recipes tag records via --metric-suffix")
+    ap.add_argument("--grad-compression", default="",
+                    choices=["", "int8", "topk", "adaptive"],
+                    help="TRAIN bench with the compressed cross-slice grad "
+                         "sync (train/compressed_step.py): hybrid (dcn, dp) "
+                         "mesh of --dcn-slices x rest, f32 psum inside each "
+                         "slice + this wire format over dcn; the record "
+                         "gains the wire accounting (dcn_wire_bytes, "
+                         "bits_per_param, ...) for the adaptive-vs-fixed "
+                         "A/Bs in docs/round16_chip_queue.sh")
+    ap.add_argument("--dcn-slices", type=int, default=0, metavar="N",
+                    help="with --grad-compression: size of the mesh's dcn "
+                         "axis (>= 2; must divide the device count). On "
+                         "single-slice hardware the axis is EMULATED over "
+                         "ICI neighbors — wire-byte accounting stays exact, "
+                         "sync timings are optimistic")
+    ap.add_argument("--dcn-budget-mbps", type=float, default=None,
+                    metavar="MBPS",
+                    help="with --grad-compression adaptive: bandwidth cap "
+                         "fed to the BitController; the scheme table is "
+                         "decided during warmup and staged STATICALLY for "
+                         "the timed loop, so the measurement has no "
+                         "per-step host round-trip")
+    ap.add_argument("--topk-frac", type=float, default=0.01, metavar="F",
+                    help="with --grad-compression topk/adaptive: kept "
+                         "fraction of entries per tensor for the top-k wire "
+                         "format (adaptive also uses F/4 as its narrowest "
+                         "rung)")
     ap.add_argument("--data-bench", action="store_true",
                     help="input-pipeline stage bench INSTEAD of the train "
                          "bench: shard read / decode / tokenize / augment / "
@@ -1512,6 +1553,41 @@ def main():
         if args.serve_scenario:
             ap.error("--serve-scenario without --serve-bench would be a "
                      "silent no-op")
+    if args.grad_compression:
+        if picked_modes:
+            ap.error(f"--grad-compression applies to the train bench only "
+                     f"(got {' '.join(picked_modes)}); the other modes never "
+                     "build the compressed step")
+        if args.dcn_slices < 2:
+            ap.error("--grad-compression requires --dcn-slices >= 2 "
+                     "(the dcn axis being compressed)")
+        if args.variant != "all_gather":
+            # Refuse, don't auto-switch — the --loss-impl rule above: variant
+            # is a recorded field and the ring ppermute has no joint-(dcn,
+            # dp) axis form (train/compressed_step.py's own refusal).
+            ap.error("--grad-compression requires --variant all_gather "
+                     "(the ring ppermute has no joint-(dcn, dp) axis form)")
+        if not (0.0 < args.topk_frac <= 1.0):
+            ap.error(f"--topk-frac must be in (0, 1], got {args.topk_frac}")
+        if (args.dcn_budget_mbps is not None
+                and args.grad_compression != "adaptive"):
+            ap.error("--dcn-budget-mbps applies to --grad-compression "
+                     "adaptive only (fixed schemes have no controller)")
+        if args.dcn_budget_mbps is not None and args.dcn_budget_mbps <= 0:
+            ap.error(f"--dcn-budget-mbps must be > 0, "
+                     f"got {args.dcn_budget_mbps}")
+    else:
+        # Same anti-silent-no-op rule as the cli train subcommand: a knob
+        # that cannot reach the measured program is refused, not dropped.
+        if args.dcn_slices:
+            ap.error("--dcn-slices without --grad-compression would be a "
+                     "silent no-op (the plain bench mesh has no dcn axis)")
+        if args.dcn_budget_mbps is not None:
+            ap.error("--dcn-budget-mbps without --grad-compression adaptive "
+                     "would be a silent no-op")
+        if args.topk_frac != 0.01:
+            ap.error("--topk-frac without --grad-compression would be a "
+                     "silent no-op")
     if args.steps_per_call < 1 or args.steps % args.steps_per_call:
         ap.error(f"steps={args.steps} must be a positive multiple of "
                  f"--steps-per-call={args.steps_per_call}")
@@ -1589,7 +1665,46 @@ def main():
     )
 
     n_dev = len(jax.devices())
-    mesh = make_mesh(n_dev)
+    if args.grad_compression:
+        # Hybrid (dcn, dp) mesh, dcn outermost and grouped by real slice on
+        # multi-slice hardware (the cli train path's arrangement, via the
+        # same helper); on one slice / CPU emulation the axis maps onto ICI
+        # neighbors — wire accounting exact, sync timing optimistic (the
+        # --dcn-slices help text caveat).
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from distributed_sigmoid_loss_tpu.parallel.multihost import (
+            _hybrid_device_array,
+        )
+
+        if n_dev % args.dcn_slices:
+            print(f"--dcn-slices {args.dcn_slices} must divide the device "
+                  f"count {n_dev}", file=sys.stderr)
+            return 2
+        devices = jax.devices()
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        if len(slice_ids) > 1:
+            if len(slice_ids) != args.dcn_slices:
+                print(f"--dcn-slices {args.dcn_slices} != actual slice "
+                      f"count {len(slice_ids)} — the dcn axis must follow "
+                      "real slice boundaries", file=sys.stderr)
+                return 2
+            arr = _hybrid_device_array(
+                args.dcn_slices, n_dev // args.dcn_slices, 1, devices
+            )
+        else:
+            # Single slice / CPU emulation carries no slice metadata: plain
+            # enumeration-order reshape (the cli train path's fallback). The
+            # bench skips the cli's --force-dcn-emulation gate — emulated
+            # A/Bs of wire formats are exactly what the recipe queue runs.
+            arr = np.array(devices)
+        mesh = Mesh(
+            arr.reshape(args.dcn_slices, n_dev // args.dcn_slices),
+            ("dcn", "dp"),
+        )
+    else:
+        mesh = make_mesh(n_dev)
 
     cfg = _base_model_config(args.model)
     import dataclasses
@@ -1682,13 +1797,37 @@ def main():
         precision=args.precision, use_pallas=args.use_pallas,
         loss_impl=args.loss_impl, ring_overlap=args.ring_overlap,
     )
-    step, shardings = make_train_step(
-        model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1,
-        moe_aux_weight=0.01 if args.moe else None,
-        accum_negatives=args.accum_negatives,
-        accum_dtype="bfloat16" if args.accum_bf16 else None,
-        gradcache_embed_dtype="bfloat16" if args.gradcache_bf16 else None,
-    )
+    if args.grad_compression:
+        from distributed_sigmoid_loss_tpu.train import (
+            make_compressed_train_step,
+            with_adaptive_compression,
+            with_error_feedback,
+        )
+
+        # EF (and the adaptive carry) ride the live state only — the
+        # checkpointless bench never sees the strip/restore cycle.
+        if args.grad_compression == "adaptive":
+            state = with_adaptive_compression(state, mesh)
+        else:
+            state = with_error_feedback(state, mesh)
+        step, shardings = make_compressed_train_step(
+            model, mesh, loss_cfg,
+            compression=args.grad_compression,
+            topk_frac=args.topk_frac,
+            accum_steps=args.accum, zero1=args.zero1,
+            moe_aux_weight=0.01 if args.moe else None,
+            accum_negatives=args.accum_negatives,
+            accum_dtype="bfloat16" if args.accum_bf16 else None,
+            gradcache_embed_dtype="bfloat16" if args.gradcache_bf16 else None,
+        )
+    else:
+        step, shardings = make_train_step(
+            model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1,
+            moe_aux_weight=0.01 if args.moe else None,
+            accum_negatives=args.accum_negatives,
+            accum_dtype="bfloat16" if args.accum_bf16 else None,
+            gradcache_embed_dtype="bfloat16" if args.gradcache_bf16 else None,
+        )
     batch = jax.device_put(batch, shardings)
 
     spc = args.steps_per_call
@@ -1744,9 +1883,38 @@ def main():
     # tunnel ``jax.block_until_ready`` returns before execution finishes (measured:
     # 10 full ViT-B/16 steps "complete" in 7ms), while a float() transfer genuinely
     # drains the queue.
+    controller = None
+    if args.grad_compression == "adaptive":
+        # Warmup doubles as the controller's observation window: each warmup
+        # step is wall-timed (the wire-bytes float() genuinely drains the
+        # queue, same tunnel rationale as the loss sync below), then ONE
+        # decision is staged for the timed loop — the measured steady state
+        # has no per-step host round-trip, so adaptive-vs-fixed A/Bs compare
+        # wire formats, not host-sync overhead.
+        import numpy as np
+
+        from distributed_sigmoid_loss_tpu.parallel.adaptive_compression import (
+            BitController,
+            leaf_sizes,
+        )
+        from distributed_sigmoid_loss_tpu.train import stage_scheme
+
+        controller = BitController(
+            leaf_sizes(state.params),
+            n_dcn=args.dcn_slices,
+            topk_frac=args.topk_frac,
+            dcn_budget_mbps=args.dcn_budget_mbps,
+        )
     for _ in range(3):
+        tw = time.perf_counter()
         state, metrics = compiled(state, batch)
+        if controller is not None:
+            wire = float(metrics["dcn_wire_bytes"])  # drains the queue
+            controller.observe(time.perf_counter() - tw, wire)
     float(metrics["loss"])
+    if controller is not None:
+        controller.decide(np.asarray(state.comp["ef_ratio"]))
+        state = stage_scheme(state, controller.scheme, mesh)
 
     import contextlib
 
@@ -1849,6 +2017,27 @@ def main():
         record["gradcache_embed_dtype"] = "bfloat16"
     if args.no_text_remat:
         record["no_text_remat"] = True
+    if args.grad_compression:
+        record["grad_compression"] = args.grad_compression
+        record["dcn_slices"] = args.dcn_slices
+        if args.grad_compression in ("topk", "adaptive"):
+            record["topk_frac"] = args.topk_frac
+        # The step's own wire accounting (obs/metrics_schema.py fields):
+        # per-device DCN egress bytes per sync round and payload bits/param.
+        record["dcn_wire_bytes"] = round(float(metrics["dcn_wire_bytes"]), 1)
+        record["bits_per_param"] = round(float(metrics["bits_per_param"]), 4)
+        record["ef_residual_norm"] = round(
+            float(metrics["ef_residual_norm"]), 6
+        )
+        if args.grad_compression == "adaptive":
+            record["compression_scheme_hist"] = [
+                int(x) for x in metrics["compression_scheme_hist"]
+            ]
+            record["dcn_bw_est_mbps"] = round(
+                controller.bw_est_mbps or 0.0, 1
+            )
+            if args.dcn_budget_mbps is not None:
+                record["dcn_budget_mbps"] = args.dcn_budget_mbps
     if hw_flops_per_step_per_dev is not None:
         hw_tflops = hw_flops_per_step_per_dev * args.steps / dt / 1e12
         if hw_tflops >= achieved_model_tflops:

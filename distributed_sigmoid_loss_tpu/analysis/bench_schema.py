@@ -55,6 +55,13 @@ BENCH_RECORD_FIELDS = frozenset(
         "ring_overlap", "zero1", "adam_mu_dtype", "accum_dtype",
         "gradcache_embed_dtype", "no_text_remat",
         "hw_tflops_per_sec_per_chip", "mfu", "hw_util",
+        # train headline, compressed DCN sync (--grad-compression): the
+        # config axes plus the step's wire accounting — per-device egress
+        # bytes/round, payload bits/param, per-scheme tensor counts, the EF
+        # residual norm, and the controller's bandwidth EWMA.
+        "grad_compression", "dcn_slices", "dcn_budget_mbps", "topk_frac",
+        "dcn_wire_bytes", "bits_per_param", "compression_scheme_hist",
+        "ef_residual_norm", "dcn_bw_est_mbps",
         # eval-throughput
         "batch", "quant", "fwd_tflops_per_sec_per_chip", "mfu_bf16_basis",
         # context bench

@@ -75,7 +75,7 @@ class StepConfig:
     ring_overlap: bool = False
     use_pallas: bool = False
     quant_train: str = ""  # "" | "int8" (tower STE mode)
-    compression: str = ""  # "" | "int8" | "topk" (dcn gradient hop)
+    compression: str = ""  # "" | "int8" | "topk" | "adaptive" (dcn grad hop)
     error_feedback: bool = False
     pp: bool = False
     zero1: bool = False
@@ -94,7 +94,7 @@ AXES: dict = {
     "ring_overlap": (False, True),
     "use_pallas": (False, True),
     "quant_train": ("", "int8"),
-    "compression": ("", "int8", "topk"),
+    "compression": ("", "int8", "topk", "adaptive"),
     "error_feedback": (False, True),
     "pp": (False, True),
     "zero1": (False, True),
@@ -163,6 +163,20 @@ CONSTRAINTS: tuple = (
         "top-k without error feedback silently drops ~99% of every gradient "
         "as pure bias",
         lambda c: c.compression != "topk" or c.error_feedback,
+    ),
+    Constraint(
+        "adaptive-needs-error-feedback",
+        "train/compressed_step.py::validate_compressed_step_args",
+        "the adaptive controller's sign/topk rungs are pure bias without the "
+        "residual carry, and scheme changes lean on it to absorb transitions",
+        lambda c: c.compression != "adaptive" or c.error_feedback,
+    ),
+    Constraint(
+        "adaptive-excludes-pp",
+        "train/compressed_step.py::validate_compressed_step_args",
+        "the controller's scheme table and stats are per GLOBAL tensor; pp "
+        "shards block-stack gradients stage-locally",
+        lambda c: not (c.compression == "adaptive" and c.pp),
     ),
     Constraint(
         "error-feedback-needs-compression",
@@ -292,6 +306,7 @@ _TIER1_EXTRAS = (
     StepConfig(family="softmax"),
     StepConfig(family="softmax", variant="ring"),
     StepConfig(compression="topk", error_feedback=True),
+    StepConfig(compression="adaptive", error_feedback=True),
 )
 
 
@@ -402,6 +417,7 @@ def probe_imperative(cfg: StepConfig) -> tuple[bool, str]:
         grad_compression=cfg.compression,
         topk_frac=0.01,
         topk_exact=False,
+        dcn_budget_mbps=None,
         ema_decay=0.999 if cfg.ema else None,
     )
     conflict = _train_config_conflicts(ns)

@@ -608,8 +608,13 @@ def _abstract_params(model, batch):
     )
 
 
-def _abstract_state(model, tx, batch, ef_slices: int | None = None):
+def _abstract_state(
+    model, tx, batch,
+    ef_slices: int | None = None,
+    comp_tensors: int | None = None,
+):
     import jax
+    import jax.numpy as jnp
 
     from distributed_sigmoid_loss_tpu.train.train_step import TrainState
 
@@ -625,6 +630,15 @@ def _abstract_state(model, tx, batch, ef_slices: int | None = None):
 
         ef = jax.eval_shape(lambda p: init_error_feedback(p, ef_slices), params)
         state = state.replace(ef=ef)
+    if comp_tensors is not None:
+        # Abstract twin of with_adaptive_compression's carry: one scheme /
+        # stat scalar per flattened param leaf, replicated on device.
+        state = state.replace(comp={
+            "scheme": jax.ShapeDtypeStruct((comp_tensors,), jnp.int32),
+            "gnorm": jax.ShapeDtypeStruct((comp_tensors,), jnp.float32),
+            "gvar": jax.ShapeDtypeStruct((comp_tensors,), jnp.float32),
+            "ef_ratio": jax.ShapeDtypeStruct((comp_tensors,), jnp.float32),
+        })
     return state
 
 
@@ -732,8 +746,15 @@ def _build_step_config(cfg, n_devices: int):
     batch_shards = dp_size * (2 if cfg.compression else 1)
     batch = _abstract_batch(mcfg, local_b * batch_shards)
     tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
+    comp_tensors = None
+    if cfg.compression == "adaptive":
+        comp_tensors = len(
+            jax.tree_util.tree_leaves(_abstract_params(model, batch))
+        )
     state = _abstract_state(
-        model, tx, batch, ef_slices=2 if cfg.error_feedback else None
+        model, tx, batch,
+        ef_slices=2 if cfg.error_feedback else None,
+        comp_tensors=comp_tensors,
     )
 
     loss_cfg = LossConfig(
@@ -769,6 +790,11 @@ def _build_step_config(cfg, n_devices: int):
     audit_kwargs: dict = {}
     if cfg.loss_impl == "chunked":
         audit_kwargs["expect_chunk_checkpoint"] = True
+    if cfg.error_feedback:
+        # Arms shard_flow's jaxpr-ef-threaded rule: step_config_jaxprs
+        # resolves the flag into flattened (invar, outvar) index sets once
+        # the trace's output structure is known.
+        audit_kwargs["check_ef_threading"] = True
     if cfg.pp:
         # GPipe's shift-register carries are drained by design
         # (parallel/pipeline.py); see shard_flow's module docstring.
@@ -809,8 +835,39 @@ def step_config_jaxprs(
             continue
         state, batch, build, kwargs = _build_step_config(cfg, n_devices)
         step = build()
-        cache[label] = (jax.make_jaxpr(step)(state, batch), kwargs)
+        if kwargs.pop("check_ef_threading", False):
+            closed, out_shape = jax.make_jaxpr(step, return_shape=True)(
+                state, batch
+            )
+            kwargs["ef_indices"] = (
+                _leaf_indices_named((state, batch), "ef"),
+                _leaf_indices_named(out_shape, "ef"),
+            )
+            cache[label] = (closed, kwargs)
+        else:
+            cache[label] = (jax.make_jaxpr(step)(state, batch), kwargs)
     return {label: cache[label] for label in sample}
+
+
+def _leaf_indices_named(tree, name: str) -> tuple:
+    """Flattened leaf positions whose pytree path contains an entry exactly
+    named ``name`` (dataclass field or dict key). Exact match — the state's
+    ``ef`` residual leaves, not the metrics dict's ``ef_norm`` scalar. Used
+    to locate the EF carry among a traced step's invars/outvars for
+    shard_flow's jaxpr-ef-threaded rule."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    hits = []
+    for i, (path, _leaf) in enumerate(leaves):
+        for entry in path:
+            key = getattr(entry, "name", None)
+            if key is None:
+                key = getattr(entry, "key", None)
+            if key == name:
+                hits.append(i)
+                break
+    return tuple(hits)
 
 
 def audit_default_step_configs(
@@ -828,8 +885,11 @@ def audit_default_step_configs(
         flow_kwargs = {
             "check_state_drop": kwargs.get("check_state_drop", True)
         }
+        if "ef_indices" in kwargs:
+            flow_kwargs["ef_indices"] = kwargs["ef_indices"]
         base_kwargs = {
-            k: v for k, v in kwargs.items() if k != "check_state_drop"
+            k: v for k, v in kwargs.items()
+            if k not in ("check_state_drop", "ef_indices")
         }
         findings.extend(audit_jaxpr(closed, label=label, **base_kwargs))
         findings.extend(

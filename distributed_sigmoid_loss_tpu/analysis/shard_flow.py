@@ -2,8 +2,8 @@
 
 Extends jaxpr_audit's ``_Auditor`` invariance walk (per-value ``(inv, red)``
 frozenset pairs: axes a value is replicated over, and the subset it is
-replicated over BECAUSE it was already reduced/gathered) with three rules
-for bug classes the base auditor's communication checks don't see:
+replicated over BECAUSE it was already reduced/gathered) with rules for bug
+classes the base auditor's communication checks don't see:
 
 - ``jaxpr-redundant-gather``: an ``all_gather`` whose operand is already
   known-invariant (replicated) over every gathered axis — W identical copies
@@ -30,6 +30,14 @@ for bug classes the base auditor's communication checks don't see:
   known-invariant over that axis — shards disagreeing on the branch would
   enter different collective sequences and deadlock the mesh (the multihost
   hang class).
+- ``jaxpr-ef-threaded``: for error-feedback step configs, each EF-residual
+  OUTPUT leaf must transitively depend on non-EF step inputs (the gradient
+  data) — a residual with no input dependence was dropped/re-zeroed, one
+  depending only on the incoming EF leaves was passed through un-updated.
+  Backward-dependence pass (``_outvar_deps``) that recurses positionally
+  through pjit/remat/shard_map and goes conservative (all-inputs union)
+  elsewhere, so it can only under-fire, never false-fire. Armed per config
+  via ``ef_indices`` from ``jaxpr_audit.step_config_jaxprs``.
 
 Run alongside the base audit by ``audit_default_step_configs`` for every
 config in the sampled product; rule catalog in docs/ANALYSIS.md.
@@ -54,6 +62,11 @@ SHARD_FLOW_RULES = (
     "jaxpr-redundant-gather",
     "jaxpr-state-drop",
     "jaxpr-collective-order",
+    # The EF residual entering a compressed step must leave it UPDATED with
+    # gradient data — never dropped (a constant output) and never passed
+    # through as a pure function of the old residual (see
+    # _check_ef_threading; ROADMAP item 2's named rule).
+    "jaxpr-ef-threaded",
 )
 
 # Collectives that synchronize across shards of an axis — the ones whose
@@ -260,17 +273,125 @@ def _check_state_drops(jaxpr, add) -> None:
             _check_state_drops(inner, add)
 
 
+# Call-like primitives whose inner jaxpr maps 1:1 positionally onto the
+# eqn's invars/outvars — the cases _outvar_deps can recurse through exactly.
+# Anything else (scan's consts+carry+xs layout, while, cond branches) falls
+# back to the conservative all-inputs union, which can only make dependence
+# sets LARGER — the rule's silent direction (it misses nothing on the
+# shipped tree, and never false-fires).
+_POSITIONAL_CALLS = frozenset({
+    "pjit", "jit", "closed_call", "core_call", "remat", "remat2",
+    "checkpoint", "custom_jvp_call", "custom_vjp_call", "shard_map", "smap",
+})
+
+
+def _positional_inner(eqn):
+    if eqn.primitive.name not in _POSITIONAL_CALLS:
+        return None
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in eqn.params:
+            inner = _jaxpr_of(eqn.params[key])
+            if (
+                inner is not None
+                and len(inner.invars) == len(eqn.invars)
+                and len(inner.outvars) == len(eqn.outvars)
+            ):
+                return inner
+    return None
+
+
+def _outvar_deps(jaxpr, memo: dict) -> list:
+    """Per-outvar transitive dependence on the jaxpr's OWN invar positions.
+
+    Forward pass over the (topologically ordered) eqns; recurses positionally
+    through _POSITIONAL_CALLS eqns and unions all inputs otherwise. Returns
+    ``[frozenset[int], ...]`` aligned with ``jaxpr.outvars``; literals and
+    constvars contribute nothing (a constant has no input dependence).
+    """
+    key = id(jaxpr)
+    if key in memo:
+        return memo[key]
+    memo[key] = [frozenset() for _ in jaxpr.outvars]  # cycle guard
+    dep: dict = {v: frozenset([i]) for i, v in enumerate(jaxpr.invars)}
+
+    def get(v):
+        if _is_literal(v):
+            return frozenset()
+        return dep.get(v, frozenset())
+
+    for eqn in jaxpr.eqns:
+        inner = _positional_inner(eqn)
+        if inner is not None:
+            inner_deps = _outvar_deps(inner, memo)
+            outsets = [
+                frozenset().union(*(get(eqn.invars[i]) for i in ideps))
+                if ideps else frozenset()
+                for ideps in inner_deps
+            ]
+        else:
+            u = (
+                frozenset().union(*(get(iv) for iv in eqn.invars))
+                if eqn.invars else frozenset()
+            )
+            outsets = [u] * len(eqn.outvars)
+        for ov, s in zip(eqn.outvars, outsets):
+            dep[ov] = s
+    result = [get(v) for v in jaxpr.outvars]
+    memo[key] = result
+    return result
+
+
+def _check_ef_threading(jaxpr, ef_indices, add) -> None:
+    """jaxpr-ef-threaded: every EF-residual output must depend on non-EF
+    inputs (gradient data). A residual that depends on NOTHING is a dropped/
+    re-zeroed carry; one that depends ONLY on the EF inputs is passed through
+    (or merely decayed) un-updated — both are the silent-drop bug class the
+    pp/quant composition already taught us (compression runs, the claimed
+    error feedback never happens, the quantization bias accumulates
+    un-carried)."""
+    ef_in, ef_out = ef_indices
+    ef_in_set = frozenset(ef_in)
+    dep_sets = _outvar_deps(jaxpr, {})
+    for o in ef_out:
+        if o >= len(dep_sets):
+            add(
+                "jaxpr-ef-threaded",
+                f"ef output index {o} out of range for {len(dep_sets)} "
+                "outputs — stale ef_indices plumbing",
+            )
+            continue
+        deps = dep_sets[o]
+        if not deps:
+            add(
+                "jaxpr-ef-threaded",
+                f"EF residual output #{o} depends on NO step inputs — the "
+                "carried residual is dropped or re-zeroed instead of "
+                "accumulating this round's compression error",
+            )
+        elif deps <= ef_in_set:
+            add(
+                "jaxpr-ef-threaded",
+                f"EF residual output #{o} depends only on the incoming EF "
+                f"state (inputs {sorted(deps)}) — passed through un-updated; "
+                "the compressed hop's error is silently discarded",
+            )
+
+
 def audit_shard_flow(
     jaxpr_or_closed,
     *,
     label: str,
     bound_axes: dict | None = None,
     check_state_drop: bool = True,
+    ef_indices: tuple | None = None,
 ) -> list[Finding]:
-    """Run the three shard-flow rules over one (closed) jaxpr.
+    """Run the shard-flow rules over one (closed) jaxpr.
 
     ``check_state_drop=False`` is the pp opt-out: GPipe's shift-register
-    carries are drained by design (see module docstring).
+    carries are drained by design (see module docstring). ``ef_indices``
+    (``(in_positions, out_positions)`` of the flattened EF-residual leaves,
+    computed by jaxpr_audit.step_config_jaxprs for error-feedback configs)
+    arms the ``jaxpr-ef-threaded`` dataflow check; None skips it.
     """
     j = _jaxpr_of(jaxpr_or_closed)
     if j is None:
@@ -285,4 +406,6 @@ def audit_shard_flow(
     auditor.walk(j, env, bound, True)
     if check_state_drop:
         _check_state_drops(j, auditor.add)
+    if ef_indices is not None:
+        _check_ef_threading(j, ef_indices, auditor.add)
     return [f for f in auditor.findings if f.rule in SHARD_FLOW_RULES]

@@ -434,17 +434,31 @@ def _train_config_conflicts(args) -> str | None:
                            "all_gather-only; there is no ring hop loop)")
         if args.ema_decay is not None:
             reasons.append("no --ema-decay")
-        if args.grad_compression == "topk" and not (0 < args.topk_frac <= 1):
+        if args.grad_compression in ("topk", "adaptive") and not (
+            0 < args.topk_frac <= 1
+        ):
             reasons.append(
                 f"--topk-frac in (0, 1], got {args.topk_frac} (it is the "
                 f"fraction of gradient entries kept per tensor)"
             )
+        if args.grad_compression == "adaptive" and args.pp > 1:
+            reasons.append(
+                "no --pp (the adaptive controller's scheme table is per "
+                "GLOBAL tensor; pp shards block grads stage-locally — use "
+                "int8/topk under pp)"
+            )
         if reasons:
             return "--grad-compression requires: " + "; ".join(reasons)
-    if args.topk_frac != 0.01 and args.grad_compression != "topk":
+    if args.topk_frac != 0.01 and args.grad_compression not in (
+        "topk", "adaptive"
+    ):
         return "--topk-frac without --grad-compression topk is a silent no-op"
-    if args.topk_exact and args.grad_compression != "topk":
+    if args.topk_exact and args.grad_compression not in ("topk", "adaptive"):
         return "--topk-exact without --grad-compression topk is a silent no-op"
+    if args.dcn_budget_mbps is not None and args.grad_compression != "adaptive":
+        return ("--dcn-budget-mbps without --grad-compression adaptive is a "
+                "silent no-op: only the adaptive bit controller consumes the "
+                "bandwidth budget")
     return None
 
 
@@ -742,13 +756,17 @@ def cmd_train(args) -> int:
     if args.grad_compression:
         from distributed_sigmoid_loss_tpu.train import (
             make_compressed_train_step,
+            with_adaptive_compression,
             with_error_feedback,
         )
 
-        # ef rides the live state only; checkpoints never include it (checkpoint._strip_ef), so compressed and plain runs share one checkpoint structure.
-        state = with_error_feedback(
-            state, mesh, pp_axis="pp" if args.pp > 1 else None
-        )
+        # ef (and the adaptive carry) ride the live state only; checkpoints never include them (checkpoint._strip_ef), so compressed and plain runs share one checkpoint structure.
+        if args.grad_compression == "adaptive":
+            state = with_adaptive_compression(state, mesh)
+        else:
+            state = with_error_feedback(
+                state, mesh, pp_axis="pp" if args.pp > 1 else None
+            )
         try:
             step_fn, shardings = make_compressed_train_step(
                 model,
@@ -774,6 +792,44 @@ def cmd_train(args) -> int:
             print(f"--grad-compression with --pp {args.pp}: {e}",
                   file=sys.stderr)
             return 2
+        if args.grad_compression == "adaptive":
+            # Host-side bit controller around the jitted step: stage the
+            # scheme table (a value change of a donated replicated operand —
+            # never a recompile), time the step, fold (duration, reported
+            # wire bytes) into the bandwidth EWMA, and re-decide from the
+            # step's per-tensor stats. The step duration upper-bounds the
+            # sync duration, so the EWMA UNDER-estimates bandwidth —
+            # conservative narrowing, never optimistic widening. Wrapping
+            # step_fn keeps one wiring for both the resilient and plain
+            # loops below.
+            import time as _time
+
+            import numpy as _np
+
+            from distributed_sigmoid_loss_tpu.parallel.adaptive_compression import (
+                BitController,
+                leaf_sizes,
+            )
+            from distributed_sigmoid_loss_tpu.train import stage_scheme
+
+            controller = BitController(
+                leaf_sizes(state.params),
+                n_dcn=dict(mesh.shape)["dcn"],
+                topk_frac=args.topk_frac,
+                dcn_budget_mbps=args.dcn_budget_mbps,
+            )
+            compiled_step = step_fn
+
+            def step_fn(st, batch):
+                st = stage_scheme(st, controller.scheme, mesh)
+                t0 = _time.perf_counter()
+                st, metrics = compiled_step(st, batch)
+                wire = float(metrics["dcn_wire_bytes"])  # blocks on the step
+                controller.observe(_time.perf_counter() - t0, wire)
+                controller.decide(_np.asarray(st.comp["ef_ratio"]))
+                metrics = dict(metrics)
+                metrics["dcn_bw_est_mbps"] = controller.bw_est_mbps or 0.0
+                return st, metrics
     else:
         # --loss-impl chunked is an all_gather memory shape; an unset --variant
         # follows it (same convention as --grad-compression selecting
@@ -952,8 +1008,17 @@ def cmd_train(args) -> int:
             print(f"WARNING: telemetry write failed: {e}", file=sys.stderr)
 
     def log_metrics(step_i, m):
+        # Most metrics are device scalars; compression_scheme_hist is a small
+        # per-scheme count vector — serialized as a list so the JSONL line
+        # stays one self-describing record.
+        def as_jsonable(v):
+            try:
+                return float(v)
+            except TypeError:
+                return [float(x) for x in v]
+
         line = {
-            **{k: float(v) for k, v in m.items()},
+            **{k: as_jsonable(v) for k, v in m.items()},
             "input_wait_frac": input_stats.input_wait_frac(),
             **att_fields,
         }
@@ -2420,16 +2485,28 @@ def main(argv=None) -> int:
                     help="allow --dcn-slices on single-slice TPU hardware "
                          "(quantization loss on ICI, no bandwidth win — for "
                          "perf experiments emulating a multi-slice topology)")
-    tr.add_argument("--grad-compression", choices=["int8", "topk"],
+    tr.add_argument("--grad-compression", "--compression",
+                    choices=["int8", "topk", "adaptive"],
                     default="",
                     help="compress the gradient sync over the dcn axis: f32 "
                          "psum on ICI; on DCN either int8 all-gather (~4x "
-                         "fewer bytes) or top-k sparsification (~50x at the "
-                         "default 1%%), both with error feedback "
-                         "(train/compressed_step.py)")
+                         "fewer bytes), top-k sparsification (~50x at the "
+                         "default 1%%), or adaptive — a per-tensor "
+                         "int8/int4/sign1/top-k scheme chosen each round by "
+                         "the bandwidth-aware bit controller "
+                         "(parallel/adaptive_compression.py); all with error "
+                         "feedback (train/compressed_step.py)")
+    tr.add_argument("--dcn-budget-mbps", type=float, default=None,
+                    metavar="MBPS",
+                    help="per-device DCN egress budget for --grad-compression "
+                         "adaptive: the bit controller narrows per-tensor "
+                         "schemes until min(measured-bandwidth EWMA, this "
+                         "budget) fits the sync round (unset: measured "
+                         "bandwidth alone)")
     tr.add_argument("--topk-frac", type=float, default=0.01, metavar="F",
                     help="fraction of entries kept per tensor under "
-                         "--grad-compression topk")
+                         "--grad-compression topk (adaptive: its top-k "
+                         "rung; the narrow rung keeps F/4)")
     tr.add_argument("--topk-exact", action="store_true",
                     help="exact lax.top_k selection instead of the default "
                          "approx_max_k (4x slower on TPU at gradient scale "

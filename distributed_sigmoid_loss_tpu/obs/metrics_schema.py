@@ -44,6 +44,15 @@ TRAIN_METRICS_FIELDS = frozenset({
     # train/train_step.py + train/compressed_step.py step metrics
     "loss", "t", "bias", "grad_norm", "param_norm", "update_ratio",
     "moe_aux", "ef_norm",
+    # train/compressed_step.py DCN wire accounting: per-device egress bytes
+    # per sync round, payload bits per parameter, the residual-carry norm
+    # (ef_norm's registered successor — both emitted), and the adaptive
+    # path's per-scheme tensor-count histogram (a small list, not a scalar).
+    "dcn_wire_bytes", "bits_per_param", "ef_residual_norm",
+    "compression_scheme_hist",
+    # parallel/adaptive_compression.py BitController bandwidth EWMA
+    # (cli.py's adaptive step wrapper merges it into the line)
+    "dcn_bw_est_mbps",
     # data/loader.py prefetch starvation (cli.py log_metrics)
     "input_wait_frac",
     # obs/attribution.py static attribution (cli.py log_metrics)

@@ -1,0 +1,403 @@
+"""Adaptive per-tensor DCN compression with a bandwidth-aware bit controller.
+
+parallel/compression.py fixes ONE scheme for every tensor (int8 or topk).
+This module makes the scheme a per-tensor runtime choice from a ladder of
+wire formats — int8, packed int4, sign+norm 1-bit (Seide et al. 1-bit SGD),
+top-k at two fractions — selected each sync round by a host-side
+:class:`BitController` from (a) per-tensor gradient statistics computed
+in-step (norm / variance / EF-residual-to-gradient ratio, cheap scalars
+pmean'd over dcn alongside the grads) and (b) a measured-DCN-bandwidth EWMA
+of timed sync rounds. The design splits cleanly across the jit boundary:
+
+- **Inside jit** (:func:`adaptive_axis_mean`): every scheme's compress →
+  all_gather → decompress → mean branch is traced ONCE into a per-tensor
+  ``lax.switch``; the active scheme arrives as an int32 table operand
+  (replicated, ``P()`` in-spec — every mesh member takes the same branch, so
+  the collectives inside the branches stay deadlock-free and the graftprove
+  collective-order rule can prove the predicate invariant). Changing schemes
+  is a VALUE change of that operand, never a recompile.
+- **On the host** (:class:`BitController`): consumes the stats + timing the
+  step emits, keeps the bandwidth EWMA, and greedily narrows tensors (lowest
+  EF-ratio first — the ones compression is hurting least) until the
+  estimated egress fits the budget. Recomputed from scratch each round, so
+  schemes widen again automatically when bandwidth recovers.
+
+Error feedback is MANDATORY here (the sign/topk rungs are pure bias without
+it): the residual carries whatever the chosen rung dropped into the next
+step, which is also what makes per-tensor scheme CHANGES safe mid-run — the
+residual absorbs the transition. Grounding: Zhang et al., "Dual-Level
+Adaptive Lossy Compression" (arXiv:2407.04272) for error-bound-driven
+per-tensor precision; Abrahamyan et al., "Learned Gradient Compression"
+(arXiv:2103.08870) for residual state as first-class carried state.
+
+Wire accounting: ``dcn_wire_bytes`` below is per-device DCN *egress* per
+sync round — ``(n_dcn - 1) * sum_i payload(scheme_i)`` — matching how
+obs/attribution.py charges an ``all_gather`` (``(W-1)*s`` per device).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_sigmoid_loss_tpu.parallel.compression import (
+    _EPS,
+    dequantize_tensor_int8,
+    densify_topk,
+    quantize_tensor_int8,
+    sparsify_topk,
+)
+
+__all__ = [
+    "SCHEME_INT8",
+    "SCHEME_INT4",
+    "SCHEME_SIGN1",
+    "SCHEME_TOPK",
+    "SCHEME_TOPK_LOW",
+    "N_SCHEMES",
+    "SCHEME_NAMES",
+    "quantize_tensor_int4",
+    "pack_int4",
+    "unpack_int4",
+    "pack_signs",
+    "unpack_signs",
+    "payload_bytes_table",
+    "leaf_sizes",
+    "adaptive_axis_mean",
+    "BitController",
+]
+
+# Scheme codes — the int32 values in the controller's per-tensor table.
+# Order is the NOMINAL wide→narrow ladder at the default topk_frac=1%; the
+# controller re-derives the true byte ordering per tensor from
+# payload_bytes_table (a large topk_frac can reorder the top-k rungs).
+SCHEME_INT8 = 0      # 1 B/param + one f32 scale          (the fixed path's 4x)
+SCHEME_INT4 = 1      # 0.5 B/param packed nibbles + scale (8x)
+SCHEME_SIGN1 = 2     # 1 bit/param + mean-|g| scale       (~32x, 1-bit SGD)
+SCHEME_TOPK = 3      # 8 B per kept entry at topk_frac    (~50x at 1%)
+SCHEME_TOPK_LOW = 4  # topk at topk_frac/4                (~200x at 1%)
+N_SCHEMES = 5
+SCHEME_NAMES = ("int8", "int4", "sign1", "topk", "topk_low")
+
+_Q4MAX = 7.0
+
+
+def quantize_tensor_int4(t: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-tensor int4: ``(q, scale)``, q in [-7, 7] as int8.
+
+    Same contract as :func:`quantize_tensor_int8` one rung narrower; EF
+    absorbs the coarser rounding. Pack pairs with :func:`pack_int4` for the
+    wire."""
+    x = t.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), _EPS) / _Q4MAX
+    q = jnp.clip(jnp.round(x / scale), -_Q4MAX, _Q4MAX).astype(jnp.int8)
+    return q, scale
+
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """Pack int8 values in [-7, 7] two-per-byte: flat int8[ceil(n/2)].
+
+    Low nibble = even index, high nibble = odd index (two's-complement
+    nibbles, recovered sign-exact by :func:`unpack_int4`'s arithmetic
+    shifts). Odd sizes pad with one zero nibble."""
+    flat = q.ravel()
+    if flat.size % 2:
+        flat = jnp.concatenate([flat, jnp.zeros((1,), jnp.int8)])
+    pairs = flat.reshape(-1, 2)
+    lo = pairs[:, 0] & jnp.int8(0x0F)
+    hi = lax.shift_left(pairs[:, 1], jnp.int8(4))
+    return hi | lo
+
+
+def unpack_int4(packed: jax.Array, size: int) -> jax.Array:
+    """Inverse of :func:`pack_int4`: flat int8[size] of values in [-7, 7]."""
+    lo = lax.shift_right_arithmetic(
+        lax.shift_left(packed, jnp.int8(4)), jnp.int8(4)
+    )
+    hi = lax.shift_right_arithmetic(packed, jnp.int8(4))
+    return jnp.stack([lo, hi], axis=1).ravel()[:size]
+
+
+def pack_signs(t: jax.Array) -> jax.Array:
+    """Sign bits of ``t`` packed 8-per-byte: flat uint8[ceil(n/8)].
+
+    Bit k of byte j holds sign(t.ravel()[8j + k]) (1 = non-negative)."""
+    bits = (t.ravel() >= 0).astype(jnp.int32)
+    pad = (-bits.size) % 8
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros((pad,), jnp.int32)])
+    weights = jnp.left_shift(jnp.int32(1), jnp.arange(8, dtype=jnp.int32))
+    return jnp.sum(bits.reshape(-1, 8) * weights, axis=-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jax.Array, size: int) -> jax.Array:
+    """Inverse of :func:`pack_signs`: flat f32[size] of ±1."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = jnp.right_shift(packed[..., None], shifts) & jnp.uint8(1)
+    flat = bits.reshape(*packed.shape[:-1], -1)[..., :size]
+    return 2.0 * flat.astype(jnp.float32) - 1.0
+
+
+def _topk_k(size: int, frac: float) -> int:
+    return max(1, int(round(frac * size)))
+
+
+def payload_bytes_table(size: int, topk_frac: float = 0.01) -> np.ndarray:
+    """Per-member wire payload in bytes for each scheme, for one tensor.
+
+    int64[N_SCHEMES], host-side (numpy) — the controller's cost model AND
+    the source of the in-jit ``dcn_wire_bytes`` gather (the step indexes
+    this constant table with the scheme operand, so the reported bytes are
+    exactly the controller's accounting). Scalar f32 scales count as 4 B;
+    top-k entries as 8 B (f32 value + int32 index)."""
+    return np.array(
+        [
+            size + 4,                              # int8: 1 B/param + scale
+            (size + 1) // 2 + 4,                   # int4: packed nibbles
+            (size + 7) // 8 + 4,                   # sign1: 1 bit/param
+            8 * _topk_k(size, topk_frac),          # topk
+            8 * _topk_k(size, topk_frac / 4.0),    # topk at frac/4
+        ],
+        dtype=np.int64,
+    )
+
+
+def leaf_sizes(params) -> list:
+    """Flattened leaf sizes of a param tree, in the order
+    :func:`adaptive_axis_mean` (and the controller's scheme table) index
+    tensors."""
+    return [int(np.prod(p.shape)) if p.shape else 1
+            for p in jax.tree.leaves(params)]
+
+
+def _mean_int8(target, axis_name, n):
+    q, s = quantize_tensor_int8(target)
+    sent = dequantize_tensor_int8(q, s)
+    qs = lax.all_gather(q, axis_name)
+    ss = lax.all_gather(s, axis_name)
+    mean = jnp.sum(
+        qs.astype(jnp.float32) * ss.reshape((n,) + (1,) * target.ndim), axis=0
+    ) / n
+    return mean, sent
+
+
+def _mean_int4(target, axis_name, n):
+    q, s = quantize_tensor_int4(target)
+    packed = pack_int4(q)
+    sent = (q.astype(jnp.float32) * s).reshape(target.shape)
+    ps = lax.all_gather(packed, axis_name)          # int4 nibbles on the wire
+    ss = lax.all_gather(s, axis_name)
+    vals = jax.vmap(lambda p: unpack_int4(p, target.size))(ps)
+    mean = jnp.sum(
+        vals.astype(jnp.float32) * ss[:, None], axis=0
+    ).reshape(target.shape) / n
+    return mean, sent
+
+
+def _mean_sign1(target, axis_name, n):
+    x = target.astype(jnp.float32)
+    scale = jnp.mean(jnp.abs(x))                    # 1-bit SGD norm scaling
+    packed = pack_signs(x)
+    sent = (unpack_signs(packed, x.size) * scale).reshape(target.shape)
+    ps = lax.all_gather(packed, axis_name)          # 1 bit/param on the wire
+    ss = lax.all_gather(scale, axis_name)
+    signs = jax.vmap(lambda p: unpack_signs(p, x.size))(ps)
+    mean = jnp.sum(signs * ss[:, None], axis=0).reshape(target.shape) / n
+    return mean, sent
+
+
+def _mean_topk(target, axis_name, n, k, approximate):
+    vals, idx = sparsify_topk(target, k, approximate=approximate)
+    sent = densify_topk(vals, idx, target.size).reshape(target.shape)
+    all_vals = lax.all_gather(vals, axis_name)      # (n, k) f32
+    all_idx = lax.all_gather(idx, axis_name)        # (n, k) int32
+    mean = (
+        jnp.zeros((target.size,), jnp.float32)
+        .at[all_idx.ravel()]
+        .add(all_vals.ravel())
+        .reshape(target.shape)
+    ) / n
+    return mean, sent
+
+
+def adaptive_axis_mean(tree, axis_name: str, ef, scheme, *,
+                       topk_frac: float = 0.01,
+                       topk_approximate: bool = True):
+    """Mean of ``tree`` over ``axis_name`` with a per-tensor adaptive wire.
+
+    The adaptive sibling of
+    :func:`~distributed_sigmoid_loss_tpu.parallel.compression.compressed_axis_mean`.
+    Must run inside ``shard_map`` manual over ``axis_name``. ``ef`` is
+    REQUIRED (same layout: leading size-1 slice dim per leaf). ``scheme`` is
+    the controller's int32[n_tensors] table, REPLICATED over the mesh
+    (``P()`` in-spec) — every member switches into the same branch, so each
+    branch's collectives stay matched. All five branches are traced once;
+    scheme changes are operand-value changes, never recompiles.
+
+    Returns ``(mean_tree, new_ef, stats, wire_bytes)``:
+
+    - ``stats``: ``{"gnorm", "gvar", "ef_ratio"}`` — f32[n_tensors] each,
+      pmean'd over ``axis_name`` (identical on every member), the
+      controller's per-tensor inputs. ``ef_ratio`` = ||residual|| / ||grad||
+      measured BEFORE this round's compression.
+    - ``wire_bytes``: f32 scalar — per-device DCN egress this round,
+      ``(n - 1) * sum_i payload_bytes_table(size_i)[scheme_i]``, gathered
+      from the constant payload table so it is exactly the controller's own
+      cost model (and costs no collective).
+    """
+    if ef is None:
+        raise ValueError(
+            "adaptive compression requires error feedback (the sign/topk "
+            "rungs are pure bias without it); create the state with "
+            "with_adaptive_compression(state, mesh)"
+        )
+    n = lax.axis_size(axis_name)
+    flat_t, treedef = jax.tree.flatten(tree)
+    flat_e = treedef.flatten_up_to(ef)
+    scheme = jnp.clip(scheme.astype(jnp.int32), 0, N_SCHEMES - 1)
+
+    means, new_ef, gnorms, gvars, ef_ratios, payloads = [], [], [], [], [], []
+    for i, (t, e) in enumerate(zip(flat_t, flat_e)):
+        res = jnp.squeeze(e, 0).astype(jnp.float32)
+        g32 = t.astype(jnp.float32)
+        target = g32 + res
+        gn = jnp.sqrt(jnp.sum(g32 * g32))
+        gnorms.append(gn)
+        gvars.append(jnp.var(g32))
+        ef_ratios.append(jnp.sqrt(jnp.sum(res * res)) / (gn + _EPS))
+
+        branches = (
+            lambda x: _mean_int8(x, axis_name, n),
+            lambda x: _mean_int4(x, axis_name, n),
+            lambda x: _mean_sign1(x, axis_name, n),
+            lambda x, k=_topk_k(t.size, topk_frac): _mean_topk(
+                x, axis_name, n, k, topk_approximate
+            ),
+            lambda x, k=_topk_k(t.size, topk_frac / 4.0): _mean_topk(
+                x, axis_name, n, k, topk_approximate
+            ),
+        )
+        mean, sent = lax.switch(scheme[i], branches, target)
+        means.append(mean.astype(t.dtype))
+        new_ef.append((target - sent)[None])
+        payloads.append(
+            jnp.asarray(payload_bytes_table(t.size, topk_frac))[scheme[i]]
+        )
+
+    stats = {
+        "gnorm": lax.pmean(jnp.stack(gnorms), axis_name),
+        "gvar": lax.pmean(jnp.stack(gvars), axis_name),
+        "ef_ratio": lax.pmean(jnp.stack(ef_ratios), axis_name),
+    }
+    wire_bytes = ((n - 1) * jnp.sum(jnp.stack(payloads))).astype(jnp.float32)
+    return (
+        treedef.unflatten(means),
+        treedef.unflatten(new_ef),
+        stats,
+        wire_bytes,
+    )
+
+
+class BitController:
+    """Host-side per-tensor scheme selection under a bandwidth budget.
+
+    Deterministic, numpy-only, and entirely OUTSIDE jit: each sync round the
+    training loop calls :meth:`observe` with the timed step duration and the
+    step's reported ``dcn_wire_bytes`` (feeding the bandwidth EWMA), then
+    :meth:`decide` with the step's per-tensor stats to get the next int32
+    scheme table — staged onto the device as a replicated operand
+    (``train.compressed_step.stage_scheme``). Decisions are recomputed from
+    scratch every round, so tensors WIDEN again when bandwidth recovers.
+
+    Policy: every tensor starts at its widest rung (by measured payload
+    bytes — the per-tensor ladder is ``payload_bytes_table`` sorted
+    descending, robust to topk_frac reordering the rungs); while the
+    estimated per-device egress ``(n_dcn-1) * sum payload`` exceeds
+    ``bytes_allowed = min(bw_est, dcn_budget_mbps) * sync_budget_s``, narrow
+    the not-yet-narrowest tensor with the LOWEST EF-residual-to-gradient
+    ratio one rung (ties: lowest index) — the tensors compression is
+    currently hurting least give up precision first.
+
+    ``override_bandwidth`` pins the EWMA for tests/drills (the reactivity
+    oracle in tests/test_adaptive_compression.py drops it and asserts a
+    narrower table within two rounds).
+    """
+
+    def __init__(self, sizes, *, n_dcn: int, topk_frac: float = 0.01,
+                 dcn_budget_mbps: float | None = None, alpha: float = 0.3,
+                 sync_budget_s: float = 0.1):
+        if n_dcn < 2:
+            raise ValueError(f"BitController needs n_dcn >= 2, got {n_dcn}")
+        self.sizes = [int(s) for s in sizes]
+        self.n_dcn = int(n_dcn)
+        self.topk_frac = float(topk_frac)
+        self.dcn_budget_mbps = (
+            None if dcn_budget_mbps is None else float(dcn_budget_mbps)
+        )
+        self.alpha = float(alpha)
+        self.sync_budget_s = float(sync_budget_s)
+        self.tables = np.stack(
+            [payload_bytes_table(s, topk_frac) for s in self.sizes]
+        )                                            # (n_tensors, N_SCHEMES)
+        # Wide→narrow rung order per tensor, by actual payload bytes.
+        self.ladders = np.argsort(-self.tables, axis=1, kind="stable")
+        self.bw_est_mbps: float | None = None
+        self._overridden = False
+        self.scheme = self.tables.argmax(axis=1).astype(np.int32)  # widest
+
+    def observe(self, duration_s: float, wire_bytes: float) -> None:
+        """Fold one timed sync round into the bandwidth EWMA."""
+        if self._overridden or duration_s <= 0 or wire_bytes <= 0:
+            return
+        inst = float(wire_bytes) * 8.0 / float(duration_s) / 1e6
+        if self.bw_est_mbps is None:
+            self.bw_est_mbps = inst
+        else:
+            self.bw_est_mbps = (
+                self.alpha * inst + (1.0 - self.alpha) * self.bw_est_mbps
+            )
+
+    def override_bandwidth(self, mbps: float | None) -> None:
+        """Pin (or, with None, release) the bandwidth estimate — test hook."""
+        self._overridden = mbps is not None
+        self.bw_est_mbps = None if mbps is None else float(mbps)
+
+    def bytes_allowed(self) -> float:
+        caps = [
+            c for c in (self.bw_est_mbps, self.dcn_budget_mbps)
+            if c is not None
+        ]
+        if not caps:
+            return float("inf")
+        return min(caps) * 1e6 / 8.0 * self.sync_budget_s
+
+    def _egress(self, rung: np.ndarray) -> int:
+        payload = self.tables[
+            np.arange(len(self.sizes)),
+            self.ladders[np.arange(len(self.sizes)), rung],
+        ]
+        return int((self.n_dcn - 1) * payload.sum())
+
+    def decide(self, ef_ratio=None) -> np.ndarray:
+        """Next per-tensor scheme table (int32[n_tensors])."""
+        n = len(self.sizes)
+        ef_ratio = (
+            np.zeros(n) if ef_ratio is None
+            else np.asarray(ef_ratio, dtype=np.float64)
+        )
+        allowed = self.bytes_allowed()
+        rung = np.zeros(n, dtype=np.int64)           # all-widest start
+        # Narrowing order: lowest EF ratio first, index as tie-break — fixed
+        # for the round (the ratio measures the CURRENT schemes, not the
+        # candidates, so re-sorting mid-descent would be noise, not signal).
+        order = sorted(range(n), key=lambda i: (ef_ratio[i], i))
+        while self._egress(rung) > allowed:
+            movable = [i for i in order if rung[i] < N_SCHEMES - 1]
+            if not movable:
+                break
+            rung[movable[0]] += 1
+        self.scheme = self.ladders[np.arange(n), rung].astype(np.int32)
+        return self.scheme

@@ -31,9 +31,14 @@ def _strip_ef(state: Any) -> Any:
     while persisting it would grow every checkpoint by a param-sized tree per
     slice AND make compressed-run checkpoints structurally incompatible with
     eval and with uncompressed resume (orbax restore is structure-strict).
-    Checkpoints therefore always have ef=None — one portable structure."""
-    if getattr(state, "ef", None) is not None:
-        return state.replace(ef=None)
+    Checkpoints therefore always have ef=None — one portable structure.
+    The adaptive-compression carry ``comp`` (scheme table + controller
+    stats) is the same class of derived state — the controller re-decides
+    from fresh observations within a round or two of resume — and is
+    stripped for the same structural-portability reason."""
+    for field in ("ef", "comp"):
+        if getattr(state, field, None) is not None:
+            state = state.replace(**{field: None})
     return state
 
 

@@ -66,6 +66,14 @@ from flax import linen as nn
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_sigmoid_loss_tpu.parallel.adaptive_compression import (
+    N_SCHEMES,
+    SCHEME_INT8,
+    SCHEME_TOPK,
+    adaptive_axis_mean,
+    leaf_sizes,
+    payload_bytes_table,
+)
 from distributed_sigmoid_loss_tpu.parallel.compression import (
     compressed_axis_mean,
     init_error_feedback,
@@ -84,7 +92,12 @@ from distributed_sigmoid_loss_tpu.train.train_step import (
 )
 from distributed_sigmoid_loss_tpu.utils.config import LossConfig
 
-__all__ = ["make_compressed_train_step", "with_error_feedback"]
+__all__ = [
+    "make_compressed_train_step",
+    "with_error_feedback",
+    "with_adaptive_compression",
+    "stage_scheme",
+]
 
 
 def with_error_feedback(
@@ -112,6 +125,50 @@ def with_error_feedback(
         out_shardings=jax.tree_util.tree_map_with_path(shard_for, state.params),
     )(state.params)
     return state.replace(ef=ef)
+
+
+def with_adaptive_compression(
+    state: TrainState, mesh: Mesh, dcn_axis: str = "dcn",
+):
+    """Attach EF plus the adaptive-compression carry (``state.comp``).
+
+    ``comp`` is a small replicated dict the step and the host-side
+    :class:`~distributed_sigmoid_loss_tpu.parallel.adaptive_compression.BitController`
+    exchange each round: ``scheme`` (int32[n_tensors], controller-written via
+    :func:`stage_scheme` — the per-tensor wire format, initially all-int8)
+    and the step-written per-tensor stats ``gnorm`` / ``gvar`` /
+    ``ef_ratio`` (f32[n_tensors]). It rides the donated state operand, so
+    scheme changes are value changes — never recompiles. Like ``ef``, it is
+    derived state: checkpoints strip it (train/checkpoint.py) and restore
+    re-attaches a fresh zero carry.
+    """
+    state = with_error_feedback(state, mesh, dcn_axis=dcn_axis)
+    n = len(jax.tree.leaves(state.params))
+    rep = NamedSharding(mesh, P())
+    comp = {
+        "scheme": jax.device_put(jnp.zeros((n,), jnp.int32), rep),
+        "gnorm": jax.device_put(jnp.zeros((n,), jnp.float32), rep),
+        "gvar": jax.device_put(jnp.zeros((n,), jnp.float32), rep),
+        "ef_ratio": jax.device_put(jnp.zeros((n,), jnp.float32), rep),
+    }
+    return state.replace(comp=comp)
+
+
+def stage_scheme(state: TrainState, scheme, mesh: Mesh) -> TrainState:
+    """Stage a controller-decided scheme table into ``state.comp``.
+
+    Re-placed with the same replicated NamedSharding the carry was created
+    with, so the donated jit sees an identical layout (no reshard, no
+    recompile) when the VALUES change between rounds."""
+    if state.comp is None:
+        raise ValueError(
+            "state has no comp carry — create it with "
+            "with_adaptive_compression(state, mesh)"
+        )
+    new = jax.device_put(
+        jnp.asarray(scheme, jnp.int32), NamedSharding(mesh, P())
+    )
+    return state.replace(comp=dict(state.comp, scheme=new))
 
 
 def validate_compressed_step_args(
@@ -177,11 +234,27 @@ def validate_compressed_step_args(
             "pp towers are dense (same constraint as make_train_step); "
             "moe_aux_weight requires the non-pp compressed path"
         )
+    if compression not in ("int8", "topk", "adaptive"):
+        raise ValueError(f"unknown compression method: {compression!r}")
     if compression == "topk" and not error_feedback:
         raise ValueError(
             "compression='topk' without error feedback silently drops "
             f"{(1 - topk_frac):.0%} of every gradient as pure bias; create "
             "the state with with_error_feedback(state, mesh)"
+        )
+    if compression == "adaptive" and not error_feedback:
+        raise ValueError(
+            "compression='adaptive' requires error feedback (its sign/topk "
+            "rungs are pure bias without the residual carry, and scheme "
+            "CHANGES lean on it to absorb the transition); create the state "
+            "with with_adaptive_compression(state, mesh)"
+        )
+    if compression == "adaptive" and pp_microbatches:
+        raise ValueError(
+            "compression='adaptive' with pp_microbatches is not supported: "
+            "the controller's scheme table and stats are per GLOBAL tensor, "
+            "but pp shards block-stack gradients stage-locally — use the "
+            "fixed int8/topk compressed path under pp"
         )
     if loss_variant != "all_gather":
         raise ValueError(
@@ -279,6 +352,8 @@ def make_compressed_train_step(
         loss_variant=loss_cfg.variant,
         mesh_axis_names=mesh.axis_names,
     )
+    adaptive = compression == "adaptive"
+    n_dcn = dict(mesh.shape)[dcn_axis]
     pp_size = 1
     if pp_microbatches:
         from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
@@ -388,7 +463,7 @@ def make_compressed_train_step(
             ell = ell + moe_aux_weight * mean_aux
         return ell, lp, mean_aux, grads
 
-    def grads_body(params, images, tokens, ef):
+    def grads_body(params, images, tokens, ef, scheme=None):
         if cached_accum:
             ell, lp, aux, grads = cached_grads(params, images, tokens)
         elif accum_steps == 1:
@@ -451,12 +526,20 @@ def make_compressed_train_step(
         # link: f32 psum-mean on ICI; compressed_axis_mean is itself a MEAN
         # over dcn, so the two hops together divide by the full world size.
         grads = jax.tree.map(lambda t: lax.psum(t, axis) / n_dp, grads)
-        grads, new_ef = compressed_axis_mean(
-            grads, dcn_axis, ef, method=compression, topk_frac=topk_frac,
-            topk_approximate=topk_approximate,
-        )
+        if adaptive:
+            grads, new_ef, stats, wire_bytes = adaptive_axis_mean(
+                grads, dcn_axis, ef, scheme, topk_frac=topk_frac,
+                topk_approximate=topk_approximate,
+            )
+        else:
+            grads, new_ef = compressed_axis_mean(
+                grads, dcn_axis, ef, method=compression, topk_frac=topk_frac,
+                topk_approximate=topk_approximate,
+            )
         loss = lax.pmean(lax.pmean(ell, axis), dcn_axis)
         aux = lax.pmean(lax.pmean(aux, axis), dcn_axis)
+        if adaptive:
+            return loss, lp, aux, grads, new_ef, stats, wire_bytes
         return loss, lp, aux, grads, new_ef
 
     data_spec = P((dcn_axis, axis))
@@ -494,11 +577,30 @@ def make_compressed_train_step(
             ef,
         )
 
+    def _fixed_wire_bytes(params) -> int:
+        """Static per-device DCN egress of the fixed int8/topk wire —
+        compile-time constant (same accounting as the adaptive path's table
+        gather: payload per LOCAL tensor slice, times the (n_dcn - 1)
+        all_gather fan-out)."""
+        col = SCHEME_INT8 if compression == "int8" else SCHEME_TOPK
+        total = 0
+        for path, p in jax.tree_util.tree_flatten_with_path(params)[0]:
+            sz = p.size
+            if pp_microbatches and is_pp_block_leaf(path, p.shape, pp_size):
+                sz //= pp_size
+            total += int(payload_bytes_table(sz, topk_frac)[col])
+        return (n_dcn - 1) * total
+
     def step(state: TrainState, batch: dict):
         if error_feedback and state.ef is None:
             raise ValueError(
                 "error_feedback=True but state.ef is None — create the state "
                 "with with_error_feedback(state, mesh)"
+            )
+        if adaptive and state.comp is None:
+            raise ValueError(
+                "compression='adaptive' but state.comp is None — create the "
+                "state with with_adaptive_compression(state, mesh)"
             )
         # Specs depend on the param tree structure (per-leaf pp placement), so
         # the shard_map is built at trace time. The synced grads/loss ARE
@@ -506,7 +608,24 @@ def make_compressed_train_step(
         # cannot prove it through the dequantized mean; unchecked like the
         # loss island (parallel/api.py).
         pspec = _param_specs(state.params)
-        if error_feedback:
+        stats = wire_bytes = None
+        if adaptive:
+            efspec = _ef_specs(state.ef)
+            # The scheme table enters REPLICATED (P()) — the per-tensor
+            # lax.switch predicate is provably uniform across members, so
+            # every member runs the same branch's collectives.
+            sharded_grads = jax.shard_map(
+                grads_body,
+                mesh=mesh,
+                in_specs=(pspec, data_spec, data_spec, efspec, P()),
+                out_specs=(P(), P(), P(), pspec, efspec, P(), P()),
+                check_vma=False,
+            )
+            loss, lp, aux, grads, new_ef, stats, wire_bytes = sharded_grads(
+                state.params, batch["images"], batch["tokens"], state.ef,
+                state.comp["scheme"],
+            )
+        elif error_feedback:
             efspec = _ef_specs(state.ef)
             sharded_grads = jax.shard_map(
                 grads_body,
@@ -555,6 +674,31 @@ def make_compressed_train_step(
         if error_feedback:
             state = state.replace(ef=new_ef)
             metrics["ef_norm"] = optax.global_norm(new_ef)
+            # ef_norm's registered name going forward (obs/metrics_schema.py);
+            # both emitted so existing dashboards keep their field.
+            metrics["ef_residual_norm"] = metrics["ef_norm"]
+        n_params = sum(leaf_sizes(state.params))
+        if adaptive:
+            scheme_in = state.comp["scheme"]
+            # scheme passes through (controller-written between steps); the
+            # per-tensor stats are this step's controller inputs.
+            state = state.replace(comp=dict(state.comp, **stats))
+            metrics["dcn_wire_bytes"] = wire_bytes
+            metrics["bits_per_param"] = (
+                wire_bytes * 8.0 / ((n_dcn - 1) * n_params)
+            )
+            metrics["compression_scheme_hist"] = jnp.bincount(
+                jnp.clip(scheme_in, 0, N_SCHEMES - 1), length=N_SCHEMES
+            )
+        else:
+            # Fixed schemes put a compile-time-constant payload on the wire;
+            # emit the same accounting so adaptive-vs-fixed A/Bs read one
+            # field (docs/round16_chip_queue.sh).
+            fixed = _fixed_wire_bytes(state.params)
+            metrics["dcn_wire_bytes"] = jnp.asarray(fixed, jnp.float32)
+            metrics["bits_per_param"] = jnp.asarray(
+                fixed * 8.0 / ((n_dcn - 1) * n_params), jnp.float32
+            )
         return state, metrics
 
     batch_sharding = {

@@ -182,14 +182,20 @@ def restore_latest(root: str, target: Any) -> tuple[Any, int] | None:
     step = latest_step(root)
     if step is None:
         return None
-    if getattr(target, "ef", None) is not None:
-        # Checkpoints never carry the error-feedback residual (see
-        # checkpoint._strip_ef); restore the portable structure and restart
-        # EF from the target's (zeroed) tree.
+    # Checkpoints never carry the error-feedback residual or the adaptive
+    # compression carry (see checkpoint._strip_ef); restore the portable
+    # structure and restart both from the target's (zeroed) trees.
+    derived = {
+        f: getattr(target, f)
+        for f in ("ef", "comp")
+        if getattr(target, f, None) is not None
+    }
+    if derived:
         bare = restore_checkpoint(
-            _step_dir(root, step), target.replace(ef=None)
+            _step_dir(root, step),
+            target.replace(**{f: None for f in derived}),
         )
-        return bare.replace(ef=target.ef), step
+        return bare.replace(**derived), step
     return restore_checkpoint(_step_dir(root, step), target), step
 
 

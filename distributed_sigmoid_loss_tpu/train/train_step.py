@@ -99,10 +99,14 @@ class TrainState(train_state.TrainState):
     as a pytree-None it adds no leaves, so states without EMA checkpoint and
     shard exactly as before). ``ef`` is the per-slice error-feedback residual
     tree of compressed DCN gradient sync (train/compressed_step.py), None
-    when compression is off — same no-leaves contract as ``ema``."""
+    when compression is off — same no-leaves contract as ``ema``. ``comp``
+    is the adaptive-compression carry (per-tensor scheme table + controller
+    stats, compressed_step.with_adaptive_compression), None unless
+    ``--grad-compression adaptive`` — again the same contract."""
 
     ema: Any = None
     ef: Any = None
+    comp: Any = None
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:

@@ -99,6 +99,16 @@ class MetricsLogger:
                 file=sys.stderr,
             )
 
+    @staticmethod
+    def _jsonable(v):
+        # Scalars (device or host) as float; small count vectors (the
+        # adaptive path's compression_scheme_hist) as a list of floats so
+        # the JSONL line stays one self-describing record.
+        try:
+            return float(v)
+        except TypeError:
+            return [float(x) for x in v]
+
     def log(self, step: int, metrics: Mapping[str, float], *,
             force: bool = False) -> None:
         """``force=True`` (out-of-band records, e.g. in-training eval) bypasses
@@ -109,7 +119,7 @@ class MetricsLogger:
             return
         now = time.perf_counter()
         record = {"step": step}
-        record.update({k: float(v) for k, v in metrics.items()})
+        record.update({k: self._jsonable(v) for k, v in metrics.items()})
         if not force:
             if self._last_time is not None and step > self._last_step:
                 record["steps_per_sec"] = (

@@ -1,0 +1,111 @@
+#!/bin/bash
+# Round-16 chip measurement queue — the graftsqueeze round: the DCN
+# gradient hop now has an adaptive per-tensor bit controller
+# (parallel/adaptive_compression.py; docs/PERF.md "Adaptive DCN
+# compression"), so this round's new entries are the adaptive-vs-fixed
+# wire A/Bs. One caveat the recipes respect: a single v5e chip has no
+# real DCN — the --dcn-slices 2 runs below split one slice's devices
+# across an emulated dcn axis, so their value is the COMPUTE price of
+# each wire format (quantize/pack/switch overhead) and the controller's
+# measured reactivity, not cross-slice bandwidth savings. The wire-byte
+# savings themselves are exact and chip-free (the payload table is the
+# accounting; tests pin it); the bandwidth win needs a real multi-slice
+# reservation, which stays queued behind this round.
+#   nohup bash docs/round16_chip_queue.sh > /tmp/r16queue.log 2>&1 &
+#
+# PERF-STREAM DEBT NOTE (carry-forward): the last driver-verified
+# headline is STILL round 3's 761.74 pairs/s/chip (vs_baseline 0.692) —
+# rounds 4/5 recorded no-backend outages and the round-10..15 pallas,
+# _32k_equiv and serving-tier recipes have no ledgered chip numbers yet.
+# Thirteen rounds of program-level wins are stacked behind one verified
+# measurement; landing chip numbers remains THE debt, and every entry
+# below lands in LEDGER.jsonl with status + fingerprint either way.
+#
+# Same recovery-waiting discipline as rounds 5-15: one bounded probe per
+# cycle until the tunnel answers, then measurements cheapest-first. NEVER
+# signal a running bench process (SIGTERM mid-XLA-compile wedges the
+# tunnel — docs/PERF.md postmortems); fresh-compile configs (which all
+# --grad-compression runs are) ride the detached compile shield
+# automatically.
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-15 queue.
+while pgrep -f round15_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+set -x
+# -1. Chip-free pre-flight (runs even if the probe loop exhausted): the
+#     full-product lint pass — now including the jaxpr-ef-threaded
+#     dataflow rule over every error-feedback config, so a residual that
+#     is dropped or passed through un-updated can never reach a chip run
+#     — the proxy regression gate, and the FULL adaptive suite (its
+#     heavyweight oracles — step parity, reactivity + no-recompile,
+#     wire <= 0.25x bf16 — are slow-tier for the 870s tier-1 budget, so
+#     this queue runs them unfiltered; there is no time box here).
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu lint --full-product
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu obs regress
+JAX_PLATFORMS=cpu python -m pytest tests/test_adaptive_compression.py -q
+
+# 0. Headline anchor first (cached compiles) — the perf stream needs ANY
+#    driver-verified train number this round; its ledger entry carries
+#    the device fingerprint that pins it.
+python bench.py
+
+# 1. The carried headline recipe (bf16 accum + mu + save_hot remat).
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot
+
+# 2. The graftsqueeze A/B ladder at b16 scale on the emulated dcn axis:
+#    uncompressed baseline, then each fixed wire format, then adaptive
+#    (unbudgeted: the controller follows the measured EWMA; on one slice
+#    ICI-fast syncs keep it at int8 — the record's
+#    compression_scheme_hist verifies that), then adaptive under a
+#    deliberately starving budget (forces the narrow rungs, measuring
+#    their full compute price: switch + pack/unpack + EF). Every record
+#    carries dcn_wire_bytes / bits_per_param / dcn_bw_est_mbps, so the
+#    ledger can plot compute-price-vs-bits directly.
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather \
+  --dcn-slices 2 --grad-compression int8
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather \
+  --dcn-slices 2 --grad-compression topk
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather \
+  --dcn-slices 2 --grad-compression adaptive
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather \
+  --dcn-slices 2 --grad-compression adaptive --dcn-budget-mbps 200 \
+  --metric-suffix _starved
+
+# 3. Round-10..15 debt, cheapest first: pallas loss engagement, the
+#    32k-equiv ladder anchor, and the serving-tier A/Bs that still have
+#    no chip numbers.
+python bench.py 256 30 b16 --use-pallas
+python bench.py 1024 30 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --metric-suffix _32k_equiv
+python bench.py 1 1 tiny --serve-bench --serve-scenario skew
+python bench.py 1 1 tiny --serve-bench --index-tier ann --swap-every 64
+
+# 4. Post-run trajectory render for the round summary.
+python -m distributed_sigmoid_loss_tpu obs ledger \
+  --metric siglip_vitb16_train_pairs_per_sec_per_chip

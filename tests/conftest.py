@@ -59,6 +59,7 @@ jax.config.update("jax_enable_x64", False)
 # (train_step, grad_compression, zero1, determinism, pp_towers — running
 # those modules whole measured ~35 min).
 _STANDARD_MODULES = {
+    "test_adaptive_compression",
     "test_analysis",
     "test_bench_shield",
     "test_bf16_numerics",

@@ -209,8 +209,11 @@ def test_fifteen_step_configs_audit_green_and_cover_all_paths():
     }
     all_findings = []
     for label, (closed, kwargs) in jaxprs.items():
+        # check_state_drop and ef_indices are shard_flow kwargs (the same
+        # split audit_default_step_configs makes); audit_jaxpr takes neither.
         audit_kwargs = {
-            k: v for k, v in kwargs.items() if k != "check_state_drop"
+            k: v for k, v in kwargs.items()
+            if k not in ("check_state_drop", "ef_indices")
         }
         all_findings += jaxpr_audit.audit_jaxpr(
             closed, label=label, **audit_kwargs

@@ -125,6 +125,10 @@ def _bench_args(**overrides):
         # round-11 serve-bench mode: warms one engine program per shape
         # bucket (+ the sharded fan-out program) — fresh compiles, shielded.
         serve_bench=False,
+        # round-16 compressed-DCN mode: hybrid (dcn, dp) shard_map step is
+        # never in the warm cache (dcn_slices/budget/topk_frac are exempt —
+        # only meaningful with this trigger flag).
+        grad_compression="",
     )
     defaults.update(overrides)
     return argparse.Namespace(**defaults)
