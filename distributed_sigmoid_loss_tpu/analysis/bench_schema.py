@@ -110,6 +110,17 @@ BENCH_RECORD_FIELDS = frozenset(
         "scenario", "offered_load", "duration_s", "tenants", "per_tenant",
         "shed_rate", "recovery_time_s", "silent_drops", "restarts",
         "shed", "admission", "swap_in_flight", "inflight",
+        # graftfleet (serve/fleet/scenarios.py run_fleet_scenario through
+        # cmd_serve_bench --fleet-scenario): the fleet_siege record — the
+        # router/wave/lease stats snaps (mirrored from SERVE_STATS_FIELDS)
+        # plus the invocation fields and the over-admission evidence: the
+        # global rate ceiling, the peak admitted rate any sliding window
+        # saw, and the count of windows that exceeded ceiling + burst
+        # (asserted zero — the bounded-staleness lease proof).
+        "replica_count", "healthy_replicas", "reroutes", "affinity_hits",
+        "lease_epoch", "lease_reclaims", "wave_id", "fleet_replicas",
+        "lease_ttl_s", "ceiling_rate", "peak_admitted_rate",
+        "over_ceiling_samples",
     )
 )
 

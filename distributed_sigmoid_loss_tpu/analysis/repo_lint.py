@@ -634,6 +634,9 @@ METRICS_SCHEMA_FILES = {
     "cli.py": "train",
     "serve/service.py": "serve",
     "serve/admission.py": "serve",
+    "serve/fleet/leases.py": "serve",
+    "serve/fleet/router.py": "serve",
+    "serve/fleet/waves.py": "serve",
     "obs/health.py": "health",
 }
 

@@ -1602,8 +1602,21 @@ def cmd_serve_bench(args) -> int:
               f"{args.batch_buckets!r}", file=sys.stderr)
         return 2
 
+    if args.fleet_scenario and args.scenario:
+        print("--fleet-scenario and --scenario are mutually exclusive (one "
+              "drill per run)", file=sys.stderr)
+        return 2
+    if not args.fleet_scenario and (args.fleet_replicas or args.lease_ttl_s):
+        print("--fleet-replicas/--lease-ttl-s only make sense with "
+              "--fleet-scenario", file=sys.stderr)
+        return 2
+    if args.fleet_scenario and args.fleet_replicas and args.fleet_replicas < 2:
+        print("--fleet-replicas must be >= 2 (with one replica there is no "
+              "sibling to reroute to and no wave to order)", file=sys.stderr)
+        return 2
+
     scenario_tenants = None
-    if args.scenario:
+    if args.scenario or args.fleet_scenario:
         from distributed_sigmoid_loss_tpu.serve import parse_tenant_spec
 
         if args.duration_s <= 0 or args.offered_load <= 0 or args.capacity < 1:
@@ -1615,6 +1628,35 @@ def cmd_serve_bench(args) -> int:
         except ValueError as e:
             print(f"--tenants: {e}", file=sys.stderr)
             return 2
+
+    if args.fleet_scenario:
+        # Like the hostloss drill below: the fleet drill runs the leased
+        # admission → router → EngineProcess stack with stdlib surrogate
+        # workers, so it exercises the fleet-tier failure semantics (lease
+        # reclaim, typed reroute, swap waves) without spinning up the
+        # jitted stack. Over-admission is a hard failure: the split-brain
+        # ceiling proof is only as good as its enforcement.
+        from distributed_sigmoid_loss_tpu.serve import run_fleet_scenario
+
+        record = run_fleet_scenario(
+            args.fleet_scenario,
+            replicas=args.fleet_replicas or 3,
+            tenants=scenario_tenants,
+            duration_s=args.duration_s,
+            offered_load=args.offered_load,
+            lease_ttl_s=args.lease_ttl_s or 0.5,
+            seed=args.seed,
+        )
+        rc = _emit_serve_record(record, strict_zero_drops=True)
+        if record.get("over_ceiling_samples"):
+            print(
+                f"WARNING: {record['over_ceiling_samples']} window sample(s) "
+                "exceeded the global admission ceiling — the bounded-"
+                "staleness lease invariant is broken",
+                file=sys.stderr,
+            )
+            return 1
+        return rc
 
     if args.scenario == "hostloss":
         # The host-loss drill runs the admission → batcher → EngineProcess
@@ -2741,6 +2783,24 @@ def main(argv=None) -> int:
     sb.add_argument("--capacity", type=int, default=64,
                     help="AdmissionController global in-flight item budget "
                          "(priority tiers partition it under overload)")
+    sb.add_argument("--fleet-scenario", default="",
+                    choices=["", "fleet-rolling-swap", "fleet-hostloss",
+                             "fleet-splitbrain"],
+                    help="graftfleet drill: N EngineProcess-backed replicas "
+                         "behind the fleet router with token-lease "
+                         "distributed admission — rolling swap wave under "
+                         "burst, replica kill -9 with lease reclaim, or "
+                         "coordinator split-brain (must under-admit, never "
+                         "over-admit); emits the fleet_siege degradation "
+                         "record (docs/SERVING.md 'Fleet tier')")
+    sb.add_argument("--fleet-replicas", type=int, default=0, metavar="N",
+                    help="replica count for --fleet-scenario (>= 2; 0 = "
+                         "unset, defaults to 3 when a fleet scenario runs)")
+    sb.add_argument("--lease-ttl-s", type=float, default=0.0, metavar="S",
+                    help="fleet lease TTL: a dead host's quota slices "
+                         "expire and redistribute within this bound (0 = "
+                         "unset, defaults to 0.5 when a fleet scenario "
+                         "runs)")
     sb.add_argument("--seed", type=int, default=0)
     sb.add_argument("--mesh", action="store_true",
                     help="shard engine batches over the dp mesh (batch "

@@ -111,6 +111,30 @@ WATCHED_LOCKS = {
         "per-tenant request tallies (ok/shed/errors/latencies) shared by "
         "the scenario's client threads"
     ),
+    "serve.fleet.leases.LeaseCoordinator._lock": (
+        "the lease table: _grants/_members/_epoch/_reclaims — grant, "
+        "renew, and the TTL expiry sweep are one atomic step so summed "
+        "live fractions can never exceed 1.0 mid-transition"
+    ),
+    "serve.fleet.leases.LeaseClient._lock": (
+        "the host's local lease snapshot (_leases/_partitioned) — the "
+        "renew thread republishes it; admission reads fractions from it "
+        "(coordinator.acquire is called OUTSIDE this lock)"
+    ),
+    "serve.fleet.leases.LeasedAdmission._lock": (
+        "per-tenant leased buckets (tokens/inflight/shed-backoff) plus the "
+        "admit-timestamp evidence deque the over-admission sweep reads"
+    ),
+    "serve.fleet.router.FleetRouter._lock": (
+        "routing state: WRR credits, per-replica+per-session in-flight "
+        "counts, lost/draining sets, session pins (replica.call and health "
+        "probes happen OUTSIDE this lock; wait_idle polls lock-free)"
+    ),
+    "serve.fleet.waves.WaveController._lock": (
+        "wave serialization: at most one swap wave in flight fleet-wide — "
+        "the drain→wait-idle→swap→undrain fan-out runs inside it, the "
+        "fleet analogue of SwapController._lock's single-swap window"
+    ),
     "obs.telemetry.TelemetryExporter._lock": (
         "the scrape-snapshot cache (_cached/_cached_at) plus scrapes/"
         "render_count — render deliberately happens inside the lock so a "

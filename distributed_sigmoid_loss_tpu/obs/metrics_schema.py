@@ -81,6 +81,12 @@ SERVE_STATS_FIELDS = frozenset({
     # (capacity/inflight/per_tenant), and the router's mid-swap flag.
     "shed", "shed_rate", "admission", "swap_in_flight",
     "capacity", "inflight", "per_tenant",
+    # serve/fleet (graftfleet): the router's replica-health + routing
+    # counters, the lease coordinator's epoch/reclaim bookkeeping, and the
+    # wave controller's wave counter — every fleet stats() snap emits only
+    # these, so the fleet_siege record stays schema-valid end to end.
+    "replica_count", "healthy_replicas", "reroutes", "affinity_hits",
+    "lease_epoch", "lease_reclaims", "wave_id",
 })
 
 # obs/health.py HealthEvent.record() — the structured watchdog events the

@@ -24,6 +24,10 @@ system (the ROADMAP's "heavy traffic" north star), runnable on CPU in tests:
   ``search`` with per-request timeouts and a ``stats()`` snapshot (qps,
   latency percentiles, batch histogram, cache hit rate, compile count) —
   plus ``RetrievalRouter``, the tiered/versioned index front end.
+- :mod:`.fleet` — the multi-host tier: bounded-staleness token-lease
+  distributed admission, the replica-group front door with session-affinity
+  pinning and typed host-loss reroute, coordinated zero-downtime swap
+  waves, and the fleet chaos scenarios.
 
 Entry point: ``python -m distributed_sigmoid_loss_tpu serve-bench`` drives the
 whole stack on synthetic data and prints the stats snapshot as JSON
@@ -49,6 +53,20 @@ from distributed_sigmoid_loss_tpu.serve.cache import (  # noqa: F401
     content_key,
 )
 from distributed_sigmoid_loss_tpu.serve.engine import InferenceEngine  # noqa: F401
+from distributed_sigmoid_loss_tpu.serve.fleet import (  # noqa: F401
+    FLEET_SCENARIOS,
+    FleetHost,
+    FleetRouter,
+    LeaseClient,
+    LeaseCoordinator,
+    LeasedAdmission,
+    NoReplicaError,
+    OverCommitError,
+    ReplicaHandle,
+    WaveController,
+    build_fleet,
+    run_fleet_scenario,
+)
 from distributed_sigmoid_loss_tpu.serve.index import RetrievalIndex  # noqa: F401
 from distributed_sigmoid_loss_tpu.serve.service import (  # noqa: F401
     EmbeddingService,
@@ -79,10 +97,19 @@ __all__ = [
     "EmbeddingCache",
     "EmbeddingService",
     "EngineProcess",
+    "FLEET_SCENARIOS",
+    "FleetHost",
+    "FleetRouter",
     "HostLostError",
     "InferenceEngine",
+    "LeaseClient",
+    "LeaseCoordinator",
+    "LeasedAdmission",
     "MicroBatcher",
+    "NoReplicaError",
+    "OverCommitError",
     "QueueFullError",
+    "ReplicaHandle",
     "RequestTimeoutError",
     "RetrievalIndex",
     "RetrievalRouter",
@@ -92,11 +119,14 @@ __all__ = [
     "ShutdownError",
     "SwapController",
     "TenantPolicy",
+    "WaveController",
+    "build_fleet",
     "chaos_enabled",
     "content_key",
     "hostloss_drill",
     "inject",
     "maybe_inject",
     "parse_tenant_spec",
+    "run_fleet_scenario",
     "run_scenario",
 ]

@@ -603,7 +603,11 @@ class EmbeddingService:
     def health(self) -> dict:
         """The ``/healthz`` payload: ``degraded`` (still HTTP 200 — the
         process is up and answering) while admission is actively shedding
-        or a hot swap is mid-flight, ``ok`` otherwise."""
+        or a hot swap is mid-flight, ``ok`` otherwise. ``reasons`` names
+        each cause machine-readably — the fleet router drains a replica on
+        ``"swap_in_flight"`` (the wave is taking it out on purpose) but
+        keeps routing to one that is merely ``"shedding"`` (pulling an
+        overloaded replica would concentrate load on its siblings)."""
         shed_rate = (
             self.admission.recent_shed_rate()
             if self.admission is not None
@@ -614,11 +618,16 @@ class EmbeddingService:
             if isinstance(self.index, RetrievalRouter)
             else False
         )
-        status = "degraded" if (shed_rate > 0 or swap) else "ok"
+        reasons = []
+        if swap:
+            reasons.append("swap_in_flight")
+        if shed_rate > 0:
+            reasons.append("shedding")
         return {
-            "status": status,
+            "status": "degraded" if reasons else "ok",
             "shed_rate": round(shed_rate, 4),
             "swap_in_flight": bool(swap),
+            "reasons": reasons,
         }
 
     def start_metrics_server(

@@ -85,6 +85,11 @@ CHAOS_POINTS = {
         "hot swap under overload: swaps serialize, searches stay on their "
         "version, /healthz must show degraded while a swap is mid-flight"
     ),
+    "fleet.partition": (
+        "lease client partitioned from the coordinator (network split): the "
+        "host must stop using its slices at the staleness bound and shed — "
+        "bounded staleness means under-admit is the only legal failure mode"
+    ),
 }
 
 # Armed fault plans, point -> FaultPlan. Mutable module state by design
@@ -310,7 +315,29 @@ class EngineProcess:
 
 # -- scenario generator -------------------------------------------------------
 
-SCENARIOS = ("burst", "skew", "slowloris", "hostloss", "swapstorm")
+SCENARIOS = (
+    "burst",
+    "skew",
+    "slowloris",
+    "hostloss",
+    "swapstorm",
+    # Fleet-tier drills (serve/fleet/scenarios.py wires the hooks): same
+    # harness, same record contract, fleet fields merged in afterwards.
+    "fleet-rolling-swap",
+    "fleet-hostloss",
+    "fleet-splitbrain",
+)
+
+# Scenarios that reuse the kill_fn/restart_fn slots (kill at 40% of the run,
+# restart at 60%): for the fleet drills "kill" is replica kill -9 or a
+# coordinator partition, and "restart" is restart+revive or heal.
+_KILL_SCENARIOS = frozenset({
+    "hostloss", "fleet-hostloss", "fleet-splitbrain",
+})
+# Scenarios that run the swap thread (swap_fn every 200ms).
+_SWAP_SCENARIOS = frozenset({"swapstorm", "fleet-rolling-swap"})
+# Scenarios with the square-wave (burst) load shape.
+_BURST_SCENARIOS = frozenset({"burst", "fleet-rolling-swap"})
 
 # Exception type names the harness counts as TYPED rejections: the contract
 # is that every non-ok outcome is one of these (anything else is a silent
@@ -323,6 +350,7 @@ _TYPED_REJECTIONS = frozenset({
     "ShutdownError",
     "RequestTimeoutError",
     "HostLostError",
+    "NoReplicaError",
 })
 
 
@@ -348,7 +376,7 @@ def run_scenario(
     *,
     submit,
     tenants,
-    admission: AdmissionController,
+    admission: AdmissionController | None,
     duration_s: float = 2.0,
     offered_load: float = 200.0,
     clients_per_tenant: int = 4,
@@ -381,10 +409,10 @@ def run_scenario(
     """
     if scenario not in SCENARIOS:
         raise ValueError(f"unknown scenario {scenario!r}; pick from {SCENARIOS}")
-    if scenario == "hostloss" and (kill_fn is None or restart_fn is None):
-        raise ValueError("hostloss scenario needs kill_fn and restart_fn")
-    if scenario == "swapstorm" and swap_fn is None:
-        raise ValueError("swapstorm scenario needs swap_fn")
+    if scenario in _KILL_SCENARIOS and (kill_fn is None or restart_fn is None):
+        raise ValueError(f"{scenario} scenario needs kill_fn and restart_fn")
+    if scenario in _SWAP_SCENARIOS and swap_fn is None:
+        raise ValueError(f"{scenario} scenario needs swap_fn")
     tenants = list(tenants)
     hog, _victims = _hog_and_victims(tenants)
     tallies = {p.name: _TenantTally() for p in tenants}
@@ -414,7 +442,7 @@ def run_scenario(
         share[hog.name] = share[hog.name] / 8.0
 
     def rate_mult(now_s: float) -> float:
-        if scenario != "burst":
+        if scenario not in _BURST_SCENARIOS:
             return 1.0
         return 2.5 if (now_s % 1.0) < 0.5 else 0.1
 
@@ -481,7 +509,7 @@ def run_scenario(
         t.start()
 
     swapper = None
-    if scenario == "swapstorm":
+    if scenario in _SWAP_SCENARIOS:
         def swap_loop():
             while not stop.wait(0.2):
                 swap_fn()
@@ -491,7 +519,7 @@ def run_scenario(
     deadline = t_start + duration_s
     killed = restarted = False
     while time.monotonic() < deadline:
-        if scenario == "hostloss":
+        if scenario in _KILL_SCENARIOS:
             now = time.monotonic() - t_start
             if not killed and now >= 0.4 * duration_s:
                 with tally_lock:
@@ -520,7 +548,11 @@ def run_scenario(
         total_sent += tally.sent
         total_shed += tally.shed
         total_drops += tally.silent_drops
-        adm_row = admission.stats()["per_tenant"].get(p.name, {})
+        adm_row = (
+            admission.stats()["per_tenant"].get(p.name, {})
+            if admission is not None
+            else {}
+        )
         per_tenant[p.name] = {
             "sent": tally.sent,
             "ok": tally.ok,

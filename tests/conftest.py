@@ -42,6 +42,22 @@ if "DSL_LEDGER_PATH" not in os.environ:
         tempfile.gettempdir(), "dsl_test_ledger.jsonl"
     )
 
+# XLA compile reuse: the tier-1 gate's dominant cost is CPU XLA compiles,
+# and the subprocess suites (cli export, quant eval, pallas train,
+# serve-bench, bench shield, multihost workers) each cold-recompile tiny-
+# model steps that another test in the run already built. A persistent
+# compilation cache turns those repeats into disk hits; subprocesses
+# inherit the env var (jax reads it at import), and the >=1s
+# min-compile-time default keeps trivial kernels out of the cache. Keys
+# include the jax/XLA version and device topology, so a toolchain bump
+# invalidates cleanly. Pre-set the var to opt out (e.g. "" disables).
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    import tempfile
+
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        tempfile.gettempdir(), "dsl_xla_cache"
+    )
+
 import jax  # noqa: E402
 
 # The env var alone is not enough: the axon TPU plugin registers itself regardless, so
@@ -69,6 +85,7 @@ _STANDARD_MODULES = {
     "test_data_pipeline",
     "test_distindex",
     "test_distributed_parity",
+    "test_fleet",
     "test_graftledger",
     "test_lockwatch",
     "test_obs",

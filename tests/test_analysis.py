@@ -630,6 +630,42 @@ def test_unregistered_metric_field_trips_metrics_schema_rule():
     ) == []
 
 
+def test_fleet_stats_fields_registered_both_sides():
+    """graftfleet schema, both sides: the fleet stats snaps (router /
+    coordinator / wave controller) validate against the SERVE registry,
+    an unregistered fleet field trips the rule, and the fleet record
+    fields ride the bench-record schema the same way."""
+    good = (
+        'snap = {"replica_count": 3, "healthy_replicas": 2,\n'
+        '        "reroutes": 1, "affinity_hits": 9}\n'
+        'snap = {"lease_epoch": 4, "lease_reclaims": 2}\n'
+        'snap = {"wave_id": 7}\n'
+    )
+    assert repo_lint.check_metrics_schema(
+        sources={"serve/fleet/router.py": good}
+    ) == []
+    bad = repo_lint.check_metrics_schema(
+        sources={"serve/fleet/router.py":
+                 'snap = {"replica_count": 3, "bogus_fleet_stat": 1}\n'}
+    )
+    assert [f.subject for f in bad] == [
+        "serve/fleet/router.py::bogus_fleet_stat"
+    ]
+    # bench-record side: the fleet_siege record fields are registered...
+    assert repo_lint.check_bench_record_fields(
+        'record = {"metric": "fleet_siege", "fleet_replicas": 3,\n'
+        '          "lease_ttl_s": 0.5, "ceiling_rate": 120.0,\n'
+        '          "peak_admitted_rate": 90.0, "over_ceiling_samples": 0,\n'
+        '          "reroutes": 1, "lease_reclaims": 2, "wave_id": 7}\n'
+    ) == []
+    # ...and an invented one trips (the falsification half).
+    bad_rec = repo_lint.check_bench_record_fields(
+        'record = {"metric": "fleet_siege", "bogus_fleet_field": 1}\n'
+    )
+    assert _rules_of(bad_rec) == ["repo-bench-record"]
+    assert bad_rec[0].subject == "bench.py::bogus_fleet_field"
+
+
 def test_metrics_schema_green_on_shipped_tree():
     assert repo_lint.check_metrics_schema() == []
 

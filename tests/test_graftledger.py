@@ -259,7 +259,7 @@ def test_regress_green_against_committed_baseline(proxies):
     out = io.StringIO()
     assert run_regress(current=proxies, stream=out) == 0, out.getvalue()
     text = out.getvalue()
-    assert "23 step configs" in text
+    assert "24 step configs" in text
     assert "green" in text
 
 

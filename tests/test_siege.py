@@ -502,7 +502,9 @@ def test_service_sheds_typed_and_counts_separately_from_queue_full():
 def test_health_degraded_while_shedding_ok_otherwise():
     service, _ = _tenant_service()
     with service:
-        assert service.health()["status"] == "ok"
+        health = service.health()
+        assert health["status"] == "ok"
+        assert health["reasons"] == []
         row = np.arange(8, dtype=np.int32)
         service.encode_text(row, tenant="free")
         with pytest.raises(ShedError):
@@ -510,6 +512,11 @@ def test_health_degraded_while_shedding_ok_otherwise():
         health = service.health()
         assert health["status"] == "degraded"
         assert health["shed_rate"] > 0
+        # The machine-readable cause: the fleet router keeps routing to a
+        # replica that is merely shedding (pulling it would concentrate
+        # load on siblings) — distinguishable from a swap drain only via
+        # this list.
+        assert health["reasons"] == ["shedding"]
 
 
 def test_health_degraded_while_swap_in_flight():
@@ -526,9 +533,14 @@ def test_health_degraded_while_swap_in_flight():
             health = service.health()
             assert health["status"] == "degraded"
             assert health["swap_in_flight"] is True
+            # "draining for swap" is machine-distinguishable from
+            # "overloaded": the wave controller drains on THIS reason.
+            assert health["reasons"] == ["swap_in_flight"]
         finally:
             router.end_swap()
-        assert service.health()["status"] == "ok"
+        health = service.health()
+        assert health["status"] == "ok"
+        assert health["reasons"] == []
 
 
 def test_healthz_endpoint_reports_degraded_and_metrics_carry_tenant_labels():
