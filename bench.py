@@ -389,6 +389,9 @@ def _fresh_compile_config(args) -> bool:
         or args.loss_family != "sigmoid"
         or args.precision != "default"
         or args.zero1
+        # Sharded-update programs (reduce-scatter + shard-local optimizer +
+        # param gather) never sit in the warm unsharded headline cache.
+        or bool(args.update_sharding)
         or args.no_text_remat
         or args.scan_layers
         or args.steps_per_call != 1  # fori_loop-fused K-step program
@@ -1236,8 +1239,19 @@ def main():
                     help="save ALL text-tower activations (measured: OOMs at the "
                          "bench config — the layer-scan stacks every saved tensor; "
                          "kept for sweeps at smaller batches)")
+    ap.add_argument("--update-sharding", choices=["off", "zero1", "full"],
+                    default="",
+                    help="cross-replica update sharding (graftshard): 'zero1' "
+                         "re-pins optimizer state over dp; 'full' "
+                         "reduce-scatters grads into a 1/W shard, runs the "
+                         "optimizer on the shard (~W x less optimizer HBM, "
+                         "recorded as opt_mem_bytes_per_replica) and "
+                         "all-gathers params once — with --grad-compression "
+                         "the dcn wire carries the shard (~W x fewer bytes); "
+                         "needs > 1 device")
     ap.add_argument("--zero1", action="store_true",
-                    help="shard optimizer state over dp (ZeRO-1); no-op on 1 chip")
+                    help="deprecated alias for --update-sharding zero1; "
+                         "no-op on 1 chip")
     ap.add_argument("--mu-bf16", action="store_true",
                     help="bf16 Adam first moment (halves that buffer; the cheap "
                          "end of the optimizer-memory ladder before ZeRO-1)")
@@ -1451,6 +1465,7 @@ def main():
         # --text-attn-impl, --scan-layers, --moe/--moe-k/--moe-group-size.
         unsupported = {
             "--accum": args.accum != 1, "--zero1": args.zero1,
+            "--update-sharding": bool(args.update_sharding),
             "--mu-bf16": args.mu_bf16, "--accum-bf16": args.accum_bf16,
             "--remat-policy": bool(args.remat_policy),
             "--metric-suffix": bool(args.metric_suffix),
@@ -1479,6 +1494,7 @@ def main():
         # honored set: batch/steps/model positionals + --data-workers.
         unsupported = {
             "--accum": args.accum != 1, "--zero1": args.zero1,
+            "--update-sharding": bool(args.update_sharding),
             "--mu-bf16": args.mu_bf16, "--accum-bf16": args.accum_bf16,
             "--remat-policy": bool(args.remat_policy),
             "--metric-suffix": bool(args.metric_suffix),
@@ -1516,6 +1532,7 @@ def main():
         # --index-tier / --swap-every.
         unsupported = {
             "--accum": args.accum != 1, "--zero1": args.zero1,
+            "--update-sharding": bool(args.update_sharding),
             "--mu-bf16": args.mu_bf16, "--accum-bf16": args.accum_bf16,
             "--remat-policy": bool(args.remat_policy),
             "--metric-suffix": bool(args.metric_suffix),
@@ -1600,6 +1617,10 @@ def main():
         ap.error("--gradcache-bf16 requires --accum > 1 with "
                  "--accum-negatives global (only the GradCache path "
                  "stashes embedding tables)")
+    if args.zero1 and args.update_sharding not in ("", "zero1"):
+        ap.error(f"--zero1 is the deprecated alias for --update-sharding "
+                 f"zero1 and contradicts --update-sharding "
+                 f"{args.update_sharding}; drop one of them")
     if args.step_breakdown:
         # Flags the breakdown mode cannot honor are refused up front (BEFORE
         # the possibly-minutes-long backend probe); a silently different
@@ -1608,6 +1629,7 @@ def main():
         # threaded through instead.
         unsupported = {
             "--accum": args.accum != 1, "--zero1": args.zero1,
+            "--update-sharding": bool(args.update_sharding),
             "--accum-bf16": args.accum_bf16,
             "--remat-policy": bool(args.remat_policy),
             "--metric-suffix": bool(args.metric_suffix),
@@ -1706,6 +1728,14 @@ def main():
     else:
         mesh = make_mesh(n_dev)
 
+    update_mode = args.update_sharding or ("zero1" if args.zero1 else "off")
+    if update_mode == "full" and dict(mesh.shape).get("dp", 1) < 2:
+        # Environment refusal (same as the builders'): nothing to
+        # reduce-scatter over on a 1-wide dp axis.
+        print("--update-sharding full requires a dp axis of size > 1, got "
+              f"mesh {dict(mesh.shape)}", file=sys.stderr)
+        return 2
+
     cfg = _base_model_config(args.model)
     import dataclasses
 
@@ -1790,7 +1820,7 @@ def main():
     batch = make_batch(jax.random.key(0))
 
     state = create_train_state(
-        jax.random.key(0), model, tx, batch, mesh, zero1=args.zero1
+        jax.random.key(0), model, tx, batch, mesh, update_sharding=update_mode
     )
     loss_cfg = LossConfig(
         variant=args.variant, family=args.loss_family,
@@ -1807,14 +1837,18 @@ def main():
         # EF (and the adaptive carry) ride the live state only — the
         # checkpointless bench never sees the strip/restore cycle.
         if args.grad_compression == "adaptive":
-            state = with_adaptive_compression(state, mesh)
+            state = with_adaptive_compression(
+                state, mesh, update_sharding=update_mode
+            )
         else:
-            state = with_error_feedback(state, mesh)
+            state = with_error_feedback(
+                state, mesh, update_sharding=update_mode
+            )
         step, shardings = make_compressed_train_step(
             model, mesh, loss_cfg,
             compression=args.grad_compression,
             topk_frac=args.topk_frac,
-            accum_steps=args.accum, zero1=args.zero1,
+            accum_steps=args.accum, update_sharding=update_mode,
             moe_aux_weight=0.01 if args.moe else None,
             accum_negatives=args.accum_negatives,
             accum_dtype="bfloat16" if args.accum_bf16 else None,
@@ -1822,7 +1856,8 @@ def main():
         )
     else:
         step, shardings = make_train_step(
-            model, mesh, loss_cfg, accum_steps=args.accum, zero1=args.zero1,
+            model, mesh, loss_cfg, accum_steps=args.accum,
+            update_sharding=update_mode,
             moe_aux_weight=0.01 if args.moe else None,
             accum_negatives=args.accum_negatives,
             accum_dtype="bfloat16" if args.accum_bf16 else None,
@@ -1899,8 +1934,22 @@ def main():
         )
         from distributed_sigmoid_loss_tpu.train import stage_scheme
 
+        if update_mode == "full":
+            # The compressor sees the reduce-scattered 1/W shard, so the
+            # controller's payload table must be shard-sized — full-tensor
+            # sizes would overestimate wire bytes W× and starve the rungs.
+            from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis
+            from distributed_sigmoid_loss_tpu.parallel.update_shard import (
+                shard_leaf_sizes,
+            )
+
+            controller_sizes = shard_leaf_sizes(
+                state.params, dict(mesh.shape)[data_axis]
+            )
+        else:
+            controller_sizes = leaf_sizes(state.params)
         controller = BitController(
-            leaf_sizes(state.params),
+            controller_sizes,
             n_dcn=args.dcn_slices,
             topk_frac=args.topk_frac,
             dcn_budget_mbps=args.dcn_budget_mbps,
@@ -2007,8 +2056,20 @@ def main():
         record["loss_impl"] = args.loss_impl
     if args.ring_overlap:
         record["ring_overlap"] = True
-    if args.zero1:
-        record["zero1"] = True
+    if update_mode != "off":
+        record["update_sharding"] = update_mode
+        if update_mode == "zero1":
+            record["zero1"] = True  # legacy field, kept for LEDGER continuity
+        # Measured at-rest optimizer bytes per replica AFTER the run — under
+        # full sharding the post-step opt_state carries its shard placement,
+        # which is the figure the ≥0.6·W× regression pin asserts on.
+        from distributed_sigmoid_loss_tpu.parallel.update_shard import (
+            opt_mem_bytes_per_replica,
+        )
+
+        opt_mem = opt_mem_bytes_per_replica(state.opt_state)
+        if opt_mem is not None:
+            record["opt_mem_bytes_per_replica"] = opt_mem
     if args.mu_bf16:
         record["adam_mu_dtype"] = "bfloat16"
     if args.accum_bf16:

@@ -52,7 +52,8 @@ BENCH_RECORD_FIELDS = frozenset(
         "attn_bwd_traced", "pallas_engaged", "pallas_mismatch",
         "moe_experts", "moe_num_selected",
         "moe_group_size", "moe_capacity_factor", "quant_train", "loss_impl",
-        "ring_overlap", "zero1", "adam_mu_dtype", "accum_dtype",
+        "ring_overlap", "zero1", "update_sharding",
+        "opt_mem_bytes_per_replica", "adam_mu_dtype", "accum_dtype",
         "gradcache_embed_dtype", "no_text_remat",
         "hw_tflops_per_sec_per_chip", "mfu", "hw_util",
         # train headline, compressed DCN sync (--grad-compression): the

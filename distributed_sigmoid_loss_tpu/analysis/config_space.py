@@ -1,7 +1,8 @@
 """graftprove half 1: the declarative step-config feature model.
 
 The step-builder lattice is six-ish orthogonal axes (loss-impl x comm x
-pallas x quant-train x pp/zero1/accum/MoE x compression) whose legality was,
+pallas x quant-train x pp/update-sharding/accum/MoE x compression) whose
+legality was,
 until this module, encoded ONLY as imperative refusals scattered across
 ``parallel/api.py``, ``train/train_step.py``, ``train/compressed_step.py``
 and the CLI's ``cmd_train`` conflict block. This module states the same
@@ -78,7 +79,7 @@ class StepConfig:
     compression: str = ""  # "" | "int8" | "topk" | "adaptive" (dcn grad hop)
     error_feedback: bool = False
     pp: bool = False
-    zero1: bool = False
+    update_sharding: str = ""  # "" | "zero1" | "full" (graftshard modes)
     accum: bool = False
     accum_negatives: str = "local"  # local | global
     moe: bool = False
@@ -97,7 +98,7 @@ AXES: dict = {
     "compression": ("", "int8", "topk", "adaptive"),
     "error_feedback": (False, True),
     "pp": (False, True),
-    "zero1": (False, True),
+    "update_sharding": ("", "zero1", "full"),
     "accum": (False, True),
     "accum_negatives": ("local", "global"),
     "moe": (False, True),
@@ -192,11 +193,18 @@ CONSTRAINTS: tuple = (
         lambda c: not (c.pp and c.accum and c.accum_negatives == "global"),
     ),
     Constraint(
-        "pp-excludes-zero1",
+        # Subsumes the zero1-era "pp-excludes-zero1" row (graftshard, PR 17):
+        # "full" is pp-excluded for the same reason, so one mode-agnostic row
+        # replaces it rather than multiplying. The other full-mode refusal —
+        # full-requires-dp>1 — is an ENVIRONMENT check (a property of the
+        # mesh instance, not the config product; this module's docstring
+        # keeps those in the builders/cmd_train) and is pinned by the exit-2
+        # CLI tests in tests/test_update_shard.py instead.
+        "pp-excludes-update-sharding",
         "train/train_step.py::validate_step_args",
-        "zero1_constrain would re-shard the stage-local moments dp-wise "
-        "every step",
-        lambda c: not (c.pp and c.zero1),
+        "the sharded update would re-shard the stage-local moments dp-wise "
+        "every step (zero1's constrain and full's reduce-scatter alike)",
+        lambda c: not (c.pp and c.update_sharding),
     ),
     Constraint(
         "pp-excludes-moe",
@@ -298,7 +306,7 @@ def label_of(cfg: StepConfig) -> str:
 # — this is exactly the lattice corner where the pp-silently-dropped-quant
 # bug class lived, and what ROADMAP item 4 asked the audit to reach.
 _TIER1_EXTRAS = (
-    StepConfig(variant="ring", zero1=True),
+    StepConfig(variant="ring", update_sharding="zero1"),
     StepConfig(variant="ring", accum=True),
     StepConfig(accum=True, accum_negatives="global"),  # GradCache
     StepConfig(variant="ring", moe=True),
@@ -307,6 +315,15 @@ _TIER1_EXTRAS = (
     StepConfig(family="softmax", variant="ring"),
     StepConfig(compression="topk", error_feedback=True),
     StepConfig(compression="adaptive", error_feedback=True),
+    # graftshard (PR 17): the sharded-update corners — the regular step's
+    # reduce-scatter+gather publish, and both compressed shapes that must
+    # prove shard-local EF threading (jaxpr-ef-threaded) and gather
+    # placement (jaxpr-gather-placement).
+    StepConfig(update_sharding="full"),
+    StepConfig(compression="int8", error_feedback=True,
+               update_sharding="full"),
+    StepConfig(compression="adaptive", error_feedback=True,
+               update_sharding="full"),
 )
 
 
@@ -401,7 +418,8 @@ def probe_imperative(cfg: StepConfig) -> tuple[bool, str]:
         moe_aux_weight=0.01 if cfg.moe else None,
         pp=2 if cfg.pp else 1,
         pp_microbatches=2 if cfg.pp else 0,
-        zero1=cfg.zero1,
+        zero1=False,  # legacy alias flag; the axis rides update_sharding
+        update_sharding=cfg.update_sharding,
         accum=2 if cfg.accum else 1,
         accum_bf16=False,
         accum_negatives=cfg.accum_negatives,
@@ -461,7 +479,6 @@ def probe_imperative(cfg: StepConfig) -> tuple[bool, str]:
                 accum_dtype=None,
                 accum_negatives=cfg.accum_negatives,
                 pp_microbatches=pp_microbatches,
-                zero1=cfg.zero1,
                 moe_aux_weight=0.01 if cfg.moe else None,
                 gradcache_embed_dtype=None,
                 compression=cfg.compression,
@@ -469,6 +486,7 @@ def probe_imperative(cfg: StepConfig) -> tuple[bool, str]:
                 topk_frac=0.01,
                 loss_variant=cfg.variant,
                 mesh_axis_names=("dcn", "dp", "pp"),
+                update_sharding=cfg.update_sharding,
             )
         else:
             from distributed_sigmoid_loss_tpu.train.train_step import (
@@ -480,10 +498,10 @@ def probe_imperative(cfg: StepConfig) -> tuple[bool, str]:
                 accum_dtype=None,
                 accum_negatives=cfg.accum_negatives,
                 pp_microbatches=pp_microbatches,
-                zero1=cfg.zero1,
                 moe_aux_weight=0.01 if cfg.moe else None,
                 gradcache_embed_dtype=None,
                 mesh_axis_names=("dp", "pp"),
+                update_sharding=cfg.update_sharding,
             )
     except ValueError as e:
         return False, f"step builder: {e}"

@@ -612,6 +612,7 @@ def _abstract_state(
     model, tx, batch,
     ef_slices: int | None = None,
     comp_tensors: int | None = None,
+    ef_full_w: int | None = None,
 ):
     import jax
     import jax.numpy as jnp
@@ -624,11 +625,30 @@ def _abstract_state(
         params,
     )
     if ef_slices is not None:
-        from distributed_sigmoid_loss_tpu.train.compressed_step import (
-            init_error_feedback,
-        )
+        if ef_full_w:
+            # update_sharding="full": the residual is shard-local, so the
+            # abstract EF must carry with_error_feedback's padded
+            # (n_dcn, padded_rows(d0, W), ...) layout or the traced step
+            # would reject the carry's shapes.
+            from distributed_sigmoid_loss_tpu.parallel.update_shard import (
+                ef_slot_shape,
+            )
 
-        ef = jax.eval_shape(lambda p: init_error_feedback(p, ef_slices), params)
+            ef = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    ef_slot_shape(x.shape, ef_slices, ef_full_w, "full"),
+                    x.dtype,
+                ),
+                params,
+            )
+        else:
+            from distributed_sigmoid_loss_tpu.train.compressed_step import (
+                init_error_feedback,
+            )
+
+            ef = jax.eval_shape(
+                lambda p: init_error_feedback(p, ef_slices), params
+            )
         state = state.replace(ef=ef)
     if comp_tensors is not None:
         # Abstract twin of with_adaptive_compression's carry: one scheme /
@@ -751,10 +771,12 @@ def _build_step_config(cfg, n_devices: int):
         comp_tensors = len(
             jax.tree_util.tree_leaves(_abstract_params(model, batch))
         )
+    full_shard = cfg.update_sharding == "full"
     state = _abstract_state(
         model, tx, batch,
         ef_slices=2 if cfg.error_feedback else None,
         comp_tensors=comp_tensors,
+        ef_full_w=dp_size if (full_shard and cfg.error_feedback) else None,
     )
 
     loss_cfg = LossConfig(
@@ -770,7 +792,7 @@ def _build_step_config(cfg, n_devices: int):
                 model, mesh, loss_cfg,
                 compression=cfg.compression,
                 error_feedback=cfg.error_feedback,
-                zero1=cfg.zero1,
+                update_sharding=cfg.update_sharding,
                 accum_steps=accum_steps,
                 accum_negatives=cfg.accum_negatives,
                 pp_microbatches=pp_microbatches,
@@ -781,7 +803,7 @@ def _build_step_config(cfg, n_devices: int):
             return make_train_step(
                 model, mesh, loss_cfg,
                 accum_steps=accum_steps,
-                zero1=cfg.zero1,
+                update_sharding=cfg.update_sharding,
                 moe_aux_weight=0.01 if cfg.moe else None,
                 pp_microbatches=pp_microbatches,
                 accum_negatives=cfg.accum_negatives,
@@ -799,6 +821,11 @@ def _build_step_config(cfg, n_devices: int):
         # GPipe's shift-register carries are drained by design
         # (parallel/pipeline.py); see shard_flow's module docstring.
         audit_kwargs["check_state_drop"] = False
+    if full_shard:
+        # Arms shard_flow's jaxpr-gather-placement rule: an all_gather of a
+        # reduce-scattered value over this axis before the update would
+        # silently re-replicate what graftshard sharded.
+        audit_kwargs["update_shard_axis"] = "dp"
     return state, batch, build, audit_kwargs
 
 
@@ -887,9 +914,11 @@ def audit_default_step_configs(
         }
         if "ef_indices" in kwargs:
             flow_kwargs["ef_indices"] = kwargs["ef_indices"]
+        if "update_shard_axis" in kwargs:
+            flow_kwargs["update_shard_axis"] = kwargs["update_shard_axis"]
         base_kwargs = {
             k: v for k, v in kwargs.items()
-            if k not in ("check_state_drop", "ef_indices")
+            if k not in ("check_state_drop", "ef_indices", "update_shard_axis")
         }
         findings.extend(audit_jaxpr(closed, label=label, **base_kwargs))
         findings.extend(

@@ -38,6 +38,19 @@ classes the base auditor's communication checks don't see:
   through pjit/remat/shard_map and goes conservative (all-inputs union)
   elsewhere, so it can only under-fire, never false-fire. Armed per config
   via ``ef_indices`` from ``jaxpr_audit.step_config_jaxprs``.
+- ``jaxpr-gather-placement``: for ``update_sharding="full"`` step configs
+  (graftshard), an ``all_gather`` over the update-shard axis whose operand
+  was produced (transitively) by a ``psum_scatter``/``reduce_scatter`` over
+  that same axis — the exact regression that silently re-replicates the
+  1/W update the reduce-scatter just paid to shard, turning the single
+  post-update param publish into a per-gradient gather storm. Forward taint
+  pass: scatters over the axis taint their outputs, taint propagates
+  through eqns (positionally through ``_POSITIONAL_CALLS``, coarsely
+  elsewhere), and a gather of a tainted value over the same axis fires.
+  Gathers of un-tainted values (the loss island's embedding all-gathers)
+  stay silent — scatter-then-gather is the discriminator, not the gather
+  itself. Armed per config via ``update_shard_axis`` from
+  ``jaxpr_audit.step_config_jaxprs``.
 
 Run alongside the base audit by ``audit_default_step_configs`` for every
 config in the sampled product; rule catalog in docs/ANALYSIS.md.
@@ -67,6 +80,10 @@ SHARD_FLOW_RULES = (
     # through as a pure function of the old residual (see
     # _check_ef_threading; ROADMAP item 2's named rule).
     "jaxpr-ef-threaded",
+    # Under update_sharding="full", a reduce-scattered value must never be
+    # all-gathered back over the shard axis before the optimizer update
+    # (see _check_gather_placement; graftshard's named rule).
+    "jaxpr-gather-placement",
 )
 
 # Collectives that synchronize across shards of an axis — the ones whose
@@ -377,6 +394,84 @@ def _check_ef_threading(jaxpr, ef_indices, add) -> None:
             )
 
 
+# ---------------------------------------------------------------------------
+# jaxpr-gather-placement: the graftshard scatter-then-gather taint pass.
+
+# The primitives that produce a shard-axis-partial value: lax.psum_scatter
+# spells either name depending on the tiled lowering, so accept both (same
+# both-spellings hedge as jaxpr_audit._SUM_PRIMS).
+_SCATTER_PRIMS = frozenset({"psum_scatter", "reduce_scatter"})
+
+
+def _check_gather_placement(jaxpr, axis, add, taint_in=None) -> list:
+    """Forward taint pass for one jaxpr level; returns per-outvar taint.
+
+    A value is TAINTED once a psum_scatter/reduce_scatter over ``axis``
+    produced it — it now holds a 1/W shard of a cross-replica sum, the thing
+    graftshard's update path must carry through the optimizer un-gathered.
+    An ``all_gather`` over the same axis of a tainted value fires: it
+    re-replicates the update the scatter just sharded (param publish is the
+    ONE sanctioned gather, and it happens on the post-update params — a
+    fresh, never-scattered value — so it cannot taint-match). Propagation is
+    positional through ``_POSITIONAL_CALLS`` (shard_map bodies included, so
+    the compressed step's manual region is walked exactly) and coarse
+    any-in-taints-all-out elsewhere; scan/cond/while interiors are scanned
+    for self-contained scatter→gather pairs without seeding, the
+    under-fire-never-false-fire direction the module promises.
+    """
+    taint: dict = {}
+    if taint_in:
+        for v, t in zip(jaxpr.invars, taint_in):
+            if t:
+                taint[v] = True
+
+    def tainted(v):
+        return not _is_literal(v) and taint.get(v, False)
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _SCATTER_PRIMS or name in _GATHER_PRIMS:
+            axes = _collective_axes(eqn)
+            if name in _SCATTER_PRIMS and axis in axes:
+                for ov in eqn.outvars:
+                    taint[ov] = True
+                continue
+            if (
+                name in _GATHER_PRIMS
+                and axis in axes
+                and any(tainted(iv) for iv in eqn.invars)
+            ):
+                aval = getattr(eqn.invars[0], "aval", None)
+                add(
+                    "jaxpr-gather-placement",
+                    f"{name} over axis {axis!r} of a value produced by a "
+                    f"reduce-scatter over the same axis ({aval}) — the 1/W "
+                    "update shard is re-replicated BEFORE the optimizer "
+                    "update, undoing graftshard's sharding and paying a "
+                    "per-gradient gather the single post-update param "
+                    "publish exists to avoid; keep the optimizer on the "
+                    "shard and gather only the updated params",
+                )
+                # The gathered output is whole again; redundant follow-on
+                # gathers are jaxpr-redundant-gather's beat, not this rule's.
+                continue
+        inner = _positional_inner(eqn)
+        if inner is not None:
+            inner_taint = _check_gather_placement(
+                inner, axis, add, [tainted(iv) for iv in eqn.invars]
+            )
+            for ov, t in zip(eqn.outvars, inner_taint):
+                if t:
+                    taint[ov] = True
+            continue
+        for _, sub in _sub_jaxprs(eqn.params):
+            _check_gather_placement(sub, axis, add)
+        if any(tainted(iv) for iv in eqn.invars):
+            for ov in eqn.outvars:
+                taint[ov] = True
+    return [tainted(v) for v in jaxpr.outvars]
+
+
 def audit_shard_flow(
     jaxpr_or_closed,
     *,
@@ -384,6 +479,7 @@ def audit_shard_flow(
     bound_axes: dict | None = None,
     check_state_drop: bool = True,
     ef_indices: tuple | None = None,
+    update_shard_axis: str | None = None,
 ) -> list[Finding]:
     """Run the shard-flow rules over one (closed) jaxpr.
 
@@ -392,6 +488,9 @@ def audit_shard_flow(
     (``(in_positions, out_positions)`` of the flattened EF-residual leaves,
     computed by jaxpr_audit.step_config_jaxprs for error-feedback configs)
     arms the ``jaxpr-ef-threaded`` dataflow check; None skips it.
+    ``update_shard_axis`` (the dp axis name, set by step_config_jaxprs for
+    ``update_sharding="full"`` configs) arms ``jaxpr-gather-placement``;
+    None skips it.
     """
     j = _jaxpr_of(jaxpr_or_closed)
     if j is None:
@@ -408,4 +507,6 @@ def audit_shard_flow(
         _check_state_drops(j, auditor.add)
     if ef_indices is not None:
         _check_ef_threading(j, ef_indices, auditor.add)
+    if update_shard_axis is not None:
+        _check_gather_placement(j, update_shard_axis, auditor.add)
     return [f for f in auditor.findings if f.rule in SHARD_FLOW_RULES]

@@ -361,9 +361,23 @@ def _train_config_conflicts(args) -> str | None:
                 "no-op (a dense model has no routers to balance)")
     if args.pp > 1 and args.moe_experts:
         return "--pp with --moe-experts is not supported (pp towers are dense)"
-    if args.pp > 1 and args.zero1:
-        return ("--pp with --zero1 is not supported (ZeRO-1 would re-shard "
-                "the stage-local moments dp-wise every step)")
+    # graftshard mode resolution: --update-sharding supersedes --zero1 (the
+    # deprecated alias). Mirrors parallel/update_shard.resolve_update_sharding
+    # without the jax import this predicate must stay free of.
+    update_mode = getattr(args, "update_sharding", "") or ""
+    if args.zero1 and update_mode not in ("", "zero1"):
+        return (f"--zero1 is the deprecated alias for --update-sharding "
+                f"zero1 and contradicts --update-sharding {update_mode}; "
+                "drop one of them")
+    if args.zero1 and not update_mode:
+        update_mode = "zero1"
+    if update_mode == "off":
+        update_mode = ""
+    if args.pp > 1 and update_mode:
+        return (f"--pp with --update-sharding {update_mode} is not supported "
+                "(the sharded update — zero1's constrain and full's "
+                "reduce-scatter alike — would re-shard the stage-local "
+                "moments dp-wise every step)")
     if args.pp_microbatches and args.pp <= 1:
         return "--pp-microbatches without --pp > 1 would be a silent no-op"
     if args.pp_microbatches < 0:
@@ -556,6 +570,22 @@ def cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 2
+    # Resolved graftshard mode ("off" | "zero1" | "full") — the conflict
+    # predicate above already refused contradictory flag pairs.
+    update_mode = args.update_sharding or ("zero1" if args.zero1 else "off")
+    if update_mode == "full":
+        from distributed_sigmoid_loss_tpu.parallel.mesh import data_axis as _dax
+
+        if dict(mesh.shape).get(_dax, 1) < 2:
+            # Environment refusal (a mesh-instance property, not flag
+            # compatibility — same split as the builders'): nothing to
+            # reduce-scatter over on a 1-wide data axis.
+            print(
+                "--update-sharding full requires a data-parallel axis of "
+                f"size > 1, got mesh {dict(mesh.shape)}",
+                file=sys.stderr,
+            )
+            return 2
 
     if args.loss_family != "sigmoid":
         import dataclasses
@@ -740,7 +770,8 @@ def cmd_train(args) -> int:
             )
             return 2
     state = create_train_state(
-        jax.random.key(0), model, tx, first, mesh, zero1=args.zero1,
+        jax.random.key(0), model, tx, first, mesh,
+        update_sharding=update_mode,
         ema=args.ema_decay is not None, zeros=resuming,
         pp_axis="pp" if args.pp > 1 else None,
     )
@@ -762,10 +793,13 @@ def cmd_train(args) -> int:
 
         # ef (and the adaptive carry) ride the live state only; checkpoints never include them (checkpoint._strip_ef), so compressed and plain runs share one checkpoint structure.
         if args.grad_compression == "adaptive":
-            state = with_adaptive_compression(state, mesh)
+            state = with_adaptive_compression(
+                state, mesh, update_sharding=update_mode
+            )
         else:
             state = with_error_feedback(
-                state, mesh, pp_axis="pp" if args.pp > 1 else None
+                state, mesh, pp_axis="pp" if args.pp > 1 else None,
+                update_sharding=update_mode,
             )
         try:
             step_fn, shardings = make_compressed_train_step(
@@ -774,7 +808,7 @@ def cmd_train(args) -> int:
                 LossConfig(variant="all_gather", family=args.loss_family,
                            precision="default", loss_impl=args.loss_impl,
                            use_pallas=args.use_pallas),
-                zero1=args.zero1,
+                update_sharding=update_mode,
                 compression=args.grad_compression,
                 topk_frac=args.topk_frac,
                 topk_approximate=not args.topk_exact,
@@ -812,8 +846,24 @@ def cmd_train(args) -> int:
             )
             from distributed_sigmoid_loss_tpu.train import stage_scheme
 
+            if update_mode == "full":
+                # The wire carries the dp reduce-scattered 1/W shard per
+                # tensor, so the controller's payload tables (its bandwidth
+                # arithmetic) must be sized to the shard, not the tensor.
+                from distributed_sigmoid_loss_tpu.parallel.mesh import (
+                    data_axis as _dax,
+                )
+                from distributed_sigmoid_loss_tpu.parallel.update_shard import (
+                    shard_leaf_sizes,
+                )
+
+                controller_sizes = shard_leaf_sizes(
+                    state.params, dict(mesh.shape)[_dax]
+                )
+            else:
+                controller_sizes = leaf_sizes(state.params)
             controller = BitController(
-                leaf_sizes(state.params),
+                controller_sizes,
                 n_dcn=dict(mesh.shape)["dcn"],
                 topk_frac=args.topk_frac,
                 dcn_budget_mbps=args.dcn_budget_mbps,
@@ -849,7 +899,7 @@ def cmd_train(args) -> int:
             accum_negatives=args.accum_negatives,
             accum_dtype="bfloat16" if args.accum_bf16 else None,
             gradcache_embed_dtype=gradcache_dt,
-            zero1=args.zero1,
+            update_sharding=update_mode,
             ema_decay=args.ema_decay,
             moe_aux_weight=moe_aux_w,
             pp_microbatches=pp_micro,
@@ -912,6 +962,21 @@ def cmd_train(args) -> int:
         print(f"WARNING: static attribution failed ({type(e).__name__}: {e}); "
               "metrics lines will not carry mfu_est/comm_bytes_total",
               file=sys.stderr)
+
+    # graftshard placement fields on every metrics line: the mode plus the
+    # measured at-rest optimizer bytes per replica (compiler accounting, the
+    # same figure bench records) — so a training-run JSONL alone shows the
+    # W× shard saving without a separate bench invocation.
+    upd_fields = {}
+    if update_mode != "off":
+        from distributed_sigmoid_loss_tpu.parallel.update_shard import (
+            opt_mem_bytes_per_replica,
+        )
+
+        upd_fields["update_sharding"] = update_mode
+        _opt_mem = opt_mem_bytes_per_replica(state.opt_state)
+        if _opt_mem is not None:
+            upd_fields["opt_mem_bytes_per_replica"] = _opt_mem
 
     # Striped-shard sources already yield this host's LOCAL rows (batch/pcnt
     # each); synthetic sources yield the same deterministic GLOBAL batch on
@@ -1021,6 +1086,7 @@ def cmd_train(args) -> int:
             **{k: as_jsonable(v) for k, v in m.items()},
             "input_wait_frac": input_stats.input_wait_frac(),
             **att_fields,
+            **upd_fields,
         }
         if watchdog is not None:
             for ev in watchdog.observe(step_i, line):
@@ -2516,8 +2582,20 @@ def main(argv=None) -> int:
                     help="host worker threads for image decode / native "
                          "generation (0 = auto: cpu_count minus the "
                          "prefetch/main threads)")
+    tr.add_argument("--update-sharding", choices=["off", "zero1", "full"],
+                    default="",
+                    help="cross-replica update sharding (graftshard, "
+                         "parallel/update_shard.py): 'zero1' re-pins "
+                         "optimizer state over dp (the classic layout); "
+                         "'full' reduce-scatters gradients into a 1/W shard, "
+                         "runs the optax update + state on the shard, and "
+                         "all-gathers params once per step — ~W x less "
+                         "optimizer HBM, and with --grad-compression the "
+                         "dcn wire compresses the shard (another ~W x fewer "
+                         "bytes); requires a dp axis > 1, excludes --pp")
     tr.add_argument("--zero1", action="store_true",
-                    help="shard optimizer state over dp (ZeRO-1) — fits "
+                    help="deprecated alias for --update-sharding zero1 — "
+                         "shard optimizer state over dp (ZeRO-1); fits "
                          "so400m-class towers in v5e HBM")
     tr.add_argument("--dcn-slices", type=int, default=1, metavar="N",
                     help="multi-slice topology: a separate dcn mesh axis of "

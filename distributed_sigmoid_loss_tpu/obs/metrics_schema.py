@@ -57,6 +57,10 @@ TRAIN_METRICS_FIELDS = frozenset({
     "input_wait_frac",
     # obs/attribution.py static attribution (cli.py log_metrics)
     "mfu_est", "comm_bytes_total",
+    # parallel/update_shard.py (graftshard): the resolved update-sharding
+    # mode and the compiler-measured at-rest optimizer bytes per replica
+    # (cli.py stamps both on every metrics line when the mode is on).
+    "update_sharding", "opt_mem_bytes_per_replica",
 })
 
 # Prefix-namespaced families (dynamic keys): the in-training eval hook logs

@@ -78,6 +78,16 @@ from distributed_sigmoid_loss_tpu.parallel.compression import (
     compressed_axis_mean,
     init_error_feedback,
 )
+from distributed_sigmoid_loss_tpu.parallel.update_shard import (
+    apply_sharded_update,
+    capture_shardings,
+    ef_slot_shape,
+    padded_rows,
+    psum_scatter_shard,
+    resolve_update_sharding,
+    shardable,
+    unpad_like,
+)
 from distributed_sigmoid_loss_tpu.train.train_step import (
     TrainState,
     _mean_moe_aux,
@@ -88,7 +98,6 @@ from distributed_sigmoid_loss_tpu.train.train_step import (
     run_gradcache,
     validate_accum_args,
     validate_trainable_quant,
-    zero1_constrain,
 )
 from distributed_sigmoid_loss_tpu.utils.config import LossConfig
 
@@ -102,7 +111,8 @@ __all__ = [
 
 def with_error_feedback(
     state: TrainState, mesh: Mesh, dcn_axis: str = "dcn",
-    pp_axis: str | None = None,
+    pp_axis: str | None = None, update_sharding: str = "off",
+    axis_name: str = "dp",
 ):
     """Attach a zeroed error-feedback tree to ``state``, sharded over dcn.
 
@@ -110,18 +120,42 @@ def with_error_feedback(
     (``make_compressed_train_step(pp_microbatches=...)``) — block-stack
     residuals additionally shard their depth dim over that axis, matching the
     stage-local gradient slices the step compresses.
+
+    ``update_sharding="full"``: the step compresses the dp reduce-scattered
+    1/W gradient shard, so the residual it carries is SHARD-LOCAL too —
+    leaves the shared placement rule shards get the padded
+    ``(n_dcn, padded_rows(d0, W), ...)`` layout sharded ``(dcn, dp)``
+    (parallel/update_shard.ef_slot_shape); everything else keeps the
+    replicated-grad ``(n_dcn, *shape)`` layout. "zero1" does not touch the
+    gradient wire and keeps the classic layout.
     """
     n = mesh.shape[dcn_axis]
     pp_size = mesh.shape[pp_axis] if pp_axis else 1
+    mode = "full" if update_sharding == "full" else "off"
+    w_dp = dict(mesh.shape).get(axis_name, 1)
 
     def shard_for(path, p):
         if pp_axis and is_pp_block_leaf(path, p.shape, pp_size):
             # EF leaf is (n_dcn, depth, ...): dcn on dim 0, pp on the depth dim.
             return NamedSharding(mesh, P(dcn_axis, pp_axis))
+        if shardable(p.shape, w_dp, mode):
+            return NamedSharding(mesh, P(dcn_axis, axis_name))
         return NamedSharding(mesh, P(dcn_axis))
 
+    if mode == "full":
+        def build_ef(p):
+            return jax.tree.map(
+                lambda x: jnp.zeros(
+                    ef_slot_shape(x.shape, n, w_dp, mode), x.dtype
+                ),
+                p,
+            )
+    else:
+        def build_ef(p):
+            return init_error_feedback(p, n)
+
     ef = jax.jit(
-        lambda p: init_error_feedback(p, n),
+        build_ef,
         out_shardings=jax.tree_util.tree_map_with_path(shard_for, state.params),
     )(state.params)
     return state.replace(ef=ef)
@@ -129,6 +163,7 @@ def with_error_feedback(
 
 def with_adaptive_compression(
     state: TrainState, mesh: Mesh, dcn_axis: str = "dcn",
+    update_sharding: str = "off", axis_name: str = "dp",
 ):
     """Attach EF plus the adaptive-compression carry (``state.comp``).
 
@@ -142,7 +177,10 @@ def with_adaptive_compression(
     derived state: checkpoints strip it (train/checkpoint.py) and restore
     re-attaches a fresh zero carry.
     """
-    state = with_error_feedback(state, mesh, dcn_axis=dcn_axis)
+    state = with_error_feedback(
+        state, mesh, dcn_axis=dcn_axis, update_sharding=update_sharding,
+        axis_name=axis_name,
+    )
     n = len(jax.tree.leaves(state.params))
     rep = NamedSharding(mesh, P())
     comp = {
@@ -177,14 +215,15 @@ def validate_compressed_step_args(
     accum_dtype: str | None,
     accum_negatives: str,
     pp_microbatches: int,
-    zero1: bool,
-    moe_aux_weight: float | None,
-    gradcache_embed_dtype: str | None,
-    compression: str,
-    error_feedback: bool,
-    topk_frac: float,
-    loss_variant: str,
+    zero1: bool = False,
+    moe_aux_weight: float | None = None,
+    gradcache_embed_dtype: str | None = None,
+    compression: str = "int8",
+    error_feedback: bool = True,
+    topk_frac: float = 0.01,
+    loss_variant: str = "all_gather",
     mesh_axis_names: tuple = ("dcn", "dp"),
+    update_sharding: str = "",
 ):
     """Pure config-compatibility refusals for
     :func:`make_compressed_train_step`, returning ``(cached_accum, acc_dt)``.
@@ -193,8 +232,9 @@ def validate_compressed_step_args(
     graftprove probe (analysis/config_space.py) calls this with a superset
     ``mesh_axis_names`` so it exercises exactly the refusals the declarative
     table must mirror; environment checks (tower shapes, quant mode of the
-    actual model) stay in the builder.
+    actual model, the full-mode dp>1 requirement) stay in the builder.
     """
+    mode = resolve_update_sharding(update_sharding, zero1)
     acc_dt = validate_accum_args(accum_steps, accum_dtype)
     if accum_negatives not in ("local", "global"):
         raise ValueError(
@@ -218,11 +258,11 @@ def validate_compressed_step_args(
                 "supported (the pp forward is already whole-batch per "
                 "accumulation step — same constraint as make_train_step)"
             )
-        if zero1:
+        if mode != "off":
             raise ValueError(
-                "zero1 with pp_microbatches is not supported (see "
-                "make_train_step's rationale: the constrain would reshard "
-                "stage-local moments dp-wise every step)"
+                f"update_sharding={mode!r} with pp_microbatches is not "
+                "supported (see make_train_step's rationale: the constrain "
+                "would reshard stage-local moments dp-wise every step)"
             )
         if pipeline_axis not in mesh_axis_names:
             raise ValueError(
@@ -281,8 +321,22 @@ def make_compressed_train_step(
     pp_microbatches: int = 0,
     moe_aux_weight: float | None = None,
     gradcache_embed_dtype: str | None = None,
+    update_sharding: str = "",
 ):
     """Build ``(state, batch) -> (state, metrics)`` with int8 DCN grad sync.
+
+    ``update_sharding`` ("off" | "zero1" | "full"; ``zero1=True`` is the
+    deprecated alias for "zero1"): under "full" the dp hop becomes an
+    explicit reduce-scatter (``psum_scatter`` per leaf, leading dim padded
+    to a multiple of W) and the compressor quantizes the 1/W SHARD over the
+    dcn wire — DCN bytes drop another ~W× on top of the rung ladder, the
+    error-feedback residual is shard-local (create the state with
+    ``with_error_feedback(..., update_sharding="full")``), and the optax
+    update + optimizer state live on the shard
+    (parallel/update_shard.apply_sharded_update). Quantization scales are
+    then per-shard rather than per-tensor — not bitwise the unsharded
+    compressed wire, unbiased under the same EF contract. Requires dp > 1;
+    pp is excluded (same refusal as the regular step).
 
     ``mesh`` must carry ``(dcn_axis, dp axis)``; the batch shards over both.
     With ``error_feedback=True`` create the state via
@@ -351,9 +405,21 @@ def make_compressed_train_step(
         topk_frac=topk_frac,
         loss_variant=loss_cfg.variant,
         mesh_axis_names=mesh.axis_names,
+        update_sharding=update_sharding,
     )
     adaptive = compression == "adaptive"
     n_dcn = dict(mesh.shape)[dcn_axis]
+    update_mode = resolve_update_sharding(update_sharding, zero1)
+    axis_sizes = dict(mesh.shape)
+    w_dp = axis_sizes.get(loss_cfg.axis_name, 1)
+    full_shard = update_mode == "full"
+    if full_shard and w_dp < 2:
+        # Environment refusal, mirroring make_train_step: nothing to
+        # scatter over on a 1-wide dp axis.
+        raise ValueError(
+            "update_sharding='full' requires a dp axis of size > 1, got "
+            f"{loss_cfg.axis_name!r}={w_dp} on mesh {axis_sizes}"
+        )
     pp_size = 1
     if pp_microbatches:
         from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
@@ -525,12 +591,36 @@ def make_compressed_train_step(
         # Reference-style explicit DP sync (= all_reduce(SUM)/W), split by
         # link: f32 psum-mean on ICI; compressed_axis_mean is itself a MEAN
         # over dcn, so the two hops together divide by the full world size.
-        grads = jax.tree.map(lambda t: lax.psum(t, axis) / n_dp, grads)
+        # Under full update sharding the dp hop is a REDUCE-SCATTER instead:
+        # each member keeps only its 1/W row block of the mean (padded where
+        # d0 % W != 0), so everything downstream — the dcn compressor, its
+        # EF residual, and the optax update outside the region — runs on the
+        # shard. Leaves the placement rule replicates (scalars, short
+        # vectors) keep the plain psum.
+        if full_shard:
+            grads = jax.tree.map(
+                lambda t: (
+                    psum_scatter_shard(t, axis, w_dp)
+                    if shardable(t.shape, w_dp, "full")
+                    else lax.psum(t, axis)
+                ) / n_dp,
+                grads,
+            )
+        else:
+            grads = jax.tree.map(lambda t: lax.psum(t, axis) / n_dp, grads)
         if adaptive:
             grads, new_ef, stats, wire_bytes = adaptive_axis_mean(
                 grads, dcn_axis, ef, scheme, topk_frac=topk_frac,
                 topk_approximate=topk_approximate,
             )
+            if full_shard:
+                # Per-tensor controller stats were computed on this member's
+                # 1/W shard and differ across dp; average them so every
+                # member (and the P() out spec) carries one consistent
+                # shard-scale figure per tensor. wire_bytes needs no repair:
+                # it is a table gather over static shard sizes + the
+                # replicated scheme, identical on every member.
+                stats = jax.tree.map(lambda s: lax.pmean(s, axis), stats)
         else:
             grads, new_ef = compressed_axis_mean(
                 grads, dcn_axis, ef, method=compression, topk_frac=topk_frac,
@@ -562,6 +652,19 @@ def make_compressed_train_step(
         )
 
     def _ef_specs(ef):
+        if full_shard:
+            # EF leaves of shardable params are (n_dcn, padded_rows(d0), ...):
+            # dcn on dim 0, the shard rows over dp — each member carries only
+            # the residual of the shard it quantizes (mirrors with_error_
+            # feedback(update_sharding="full")).
+            return jax.tree.map(
+                lambda e: (
+                    P(dcn_axis, axis)
+                    if shardable(e.shape[1:], w_dp, "full")
+                    else P(dcn_axis)
+                ),
+                ef,
+            )
         if not pp_microbatches:
             return P(dcn_axis)
         from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
@@ -577,6 +680,20 @@ def make_compressed_train_step(
             ef,
         )
 
+    def _grad_out_specs(params):
+        """out_specs of the synced grads: under full sharding each shardable
+        leaf leaves the region as its member's row block (local
+        (padded/W, ...), global the padded tensor sharded P(dp)); otherwise
+        the param specs (replicated, or stage-local under pp)."""
+        if not full_shard:
+            return _param_specs(params)
+        return jax.tree.map(
+            lambda p: (
+                P(axis) if shardable(p.shape, w_dp, "full") else P()
+            ),
+            params,
+        )
+
     def _fixed_wire_bytes(params) -> int:
         """Static per-device DCN egress of the fixed int8/topk wire —
         compile-time constant (same accounting as the adaptive path's table
@@ -588,10 +705,15 @@ def make_compressed_train_step(
             sz = p.size
             if pp_microbatches and is_pp_block_leaf(path, p.shape, pp_size):
                 sz //= pp_size
+            elif full_shard and shardable(p.shape, w_dp, "full"):
+                # The wire carries this member's padded 1/W row block.
+                sz = (padded_rows(p.shape[0], w_dp) // w_dp) * (
+                    sz // p.shape[0]
+                )
             total += int(payload_bytes_table(sz, topk_frac)[col])
         return (n_dcn - 1) * total
 
-    def step(state: TrainState, batch: dict):
+    def step(state: TrainState, batch: dict, param_out_shardings=None):
         if error_feedback and state.ef is None:
             raise ValueError(
                 "error_feedback=True but state.ef is None — create the state "
@@ -608,6 +730,7 @@ def make_compressed_train_step(
         # cannot prove it through the dequantized mean; unchecked like the
         # loss island (parallel/api.py).
         pspec = _param_specs(state.params)
+        gspec = _grad_out_specs(state.params)
         stats = wire_bytes = None
         if adaptive:
             efspec = _ef_specs(state.ef)
@@ -618,7 +741,7 @@ def make_compressed_train_step(
                 grads_body,
                 mesh=mesh,
                 in_specs=(pspec, data_spec, data_spec, efspec, P()),
-                out_specs=(P(), P(), P(), pspec, efspec, P(), P()),
+                out_specs=(P(), P(), P(), gspec, efspec, P(), P()),
                 check_vma=False,
             )
             loss, lp, aux, grads, new_ef, stats, wire_bytes = sharded_grads(
@@ -631,7 +754,7 @@ def make_compressed_train_step(
                 grads_body,
                 mesh=mesh,
                 in_specs=(pspec, data_spec, data_spec, efspec),
-                out_specs=(P(), P(), P(), pspec, efspec),
+                out_specs=(P(), P(), P(), gspec, efspec),
                 check_vma=False,
             )
             loss, lp, aux, grads, new_ef = sharded_grads(
@@ -643,18 +766,25 @@ def make_compressed_train_step(
                 lambda p, im, tk: grads_body(p, im, tk, None)[:4],
                 mesh=mesh,
                 in_specs=(pspec, data_spec, data_spec),
-                out_specs=(P(), P(), P(), pspec),
+                out_specs=(P(), P(), P(), gspec),
                 check_vma=False,
             )
             loss, lp, aux, grads = sharded_grads(
                 state.params, batch["images"], batch["tokens"]
             )
+        if full_shard:
+            # Back to param shapes: slice the GSPMD-padded leading dims off
+            # (a local mask on a dp-sharded dim, not a gather); the grads
+            # stay dp-sharded into the optax update below.
+            grads = unpad_like(grads, state.params)
         prev_params = state.params  # update_ratio needs the pre-update tree
-        state = state.apply_gradients(grads=grads)
-        if zero1:
-            state = state.replace(
-                opt_state=zero1_constrain(state.opt_state, mesh, axis)
-            )
+        # The shared update-shard recipe (parallel/update_shard.py): plain
+        # apply under "off", the historical opt-state re-pin under "zero1",
+        # shard-local optax + one param all-gather publish under "full".
+        state = apply_sharded_update(
+            state, grads, mesh=mesh, axis_name=axis, mode=update_mode,
+            param_shardings=param_out_shardings,
+        )
         # Same health scalars as make_train_step (obs/health.py watchdog
         # inputs) — the metrics-line contract must not differ per step mode.
         param_norm = optax.global_norm(state.params)
@@ -705,4 +835,31 @@ def make_compressed_train_step(
         "images": NamedSharding(mesh, data_spec),
         "tokens": NamedSharding(mesh, data_spec),
     }
-    return jax.jit(step, donate_argnums=(0,)), batch_sharding
+    if not full_shard:
+        return jax.jit(step, donate_argnums=(0,)), batch_sharding
+
+    # Full mode: capture the params' at-rest shardings (the all-gather
+    # publish target) from the first concrete state — same deferred-jit
+    # contract as make_train_step's full path; abstract traces capture KEEP
+    # and leave the publish to the compiler.
+    _jitted = []
+
+    def _inner(state):
+        if not _jitted:
+            shardings = capture_shardings(state.params)
+            _jitted.append(jax.jit(
+                lambda s, b: step(s, b, param_out_shardings=shardings),
+                donate_argnums=(0,),
+            ))
+        return _jitted[0]
+
+    def sharded_step(state: TrainState, batch: dict):
+        return _inner(state)(state, batch)
+
+    sharded_step._cache_size = (
+        lambda: _jitted[0]._cache_size() if _jitted else 0
+    )
+    # AOT path (bench.py's step.lower(...).compile()): same capture, same
+    # single inner jit — lowering and calling share one executable.
+    sharded_step.lower = lambda state, batch: _inner(state).lower(state, batch)
+    return sharded_step, batch_sharding

@@ -27,6 +27,13 @@ from flax.training import train_state
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_sigmoid_loss_tpu.parallel.update_shard import (
+    apply_sharded_update,
+    capture_shardings,
+    constrain_update_sharding,
+    resolve_update_sharding,
+    update_shard_spec,
+)
 from distributed_sigmoid_loss_tpu.utils.config import LossConfig, TrainConfig
 
 __all__ = [
@@ -195,10 +202,11 @@ def validate_step_args(
     accum_dtype: str | None,
     accum_negatives: str,
     pp_microbatches: int,
-    zero1: bool,
-    moe_aux_weight: float | None,
-    gradcache_embed_dtype: str | None,
+    zero1: bool = False,
+    moe_aux_weight: float | None = None,
+    gradcache_embed_dtype: str | None = None,
     mesh_axis_names: tuple = ("dp",),
+    update_sharding: str = "",
 ):
     """Pure config-compatibility refusals for :func:`make_train_step`,
     returning ``(cached_accum, acc_dt)``.
@@ -207,9 +215,16 @@ def validate_step_args(
     compatibility, cross-checked against the declarative table in
     analysis/config_space.py by the graftprove probe (which calls this with
     a superset ``mesh_axis_names``). Environment checks (tower shapes via
-    validate_pp_tower, state contents) stay in make_train_step: they depend
-    on the model/mesh instance, not the config point.
+    validate_pp_tower, state contents, the full-mode dp>1 requirement) stay
+    in make_train_step: they depend on the model/mesh instance, not the
+    config point.
+
+    ``update_sharding`` / ``zero1``: resolved through
+    :func:`~distributed_sigmoid_loss_tpu.parallel.update_shard.resolve_update_sharding`
+    (``zero1`` is the deprecated alias for ``update_sharding="zero1"``); any
+    sharded-update mode is refused under pp.
     """
+    mode = resolve_update_sharding(update_sharding, zero1)
     if accum_negatives not in ("local", "global"):
         raise ValueError(
             f"accum_negatives must be 'local' or 'global', got {accum_negatives!r}"
@@ -239,12 +254,15 @@ def validate_step_args(
                 "pp towers are dense (Block.apply drops sown aux losses); "
                 "moe_aux_weight requires the non-pp path"
             )
-        if zero1:
-            # zero1_constrain would re-shard the stage-local (pp-sharded) adam
-            # moments dp-wise on every step — defeating both memory stories
-            # with a silent per-step reshard. Refuse until a pp-aware ZeRO
-            # placement exists.
-            raise ValueError("zero1 with pp_microbatches is not supported")
+        if mode != "off":
+            # The update-shard constraints would re-shard the stage-local
+            # (pp-sharded) adam moments dp-wise on every step — defeating
+            # both memory stories with a silent per-step reshard. Refuse
+            # until a pp-aware update-shard placement exists.
+            raise ValueError(
+                f"update_sharding={mode!r} with pp_microbatches is not "
+                "supported"
+            )
         if pipeline_axis not in mesh_axis_names:
             raise ValueError(
                 f"pp_microbatches={pp_microbatches} needs a mesh with a "
@@ -405,10 +423,11 @@ def param_shardings(mesh: Mesh, abstract_params) -> Any:
 def _zero1_spec(shape, dp: int, axis_name: str) -> P:
     """ZeRO-1 placement for one optimizer-state leaf: shard the leading dim over
     the data axis when it divides evenly, replicate otherwise (scalars, probes,
-    position embeddings)."""
-    if len(shape) >= 1 and shape[0] >= dp and shape[0] % dp == 0:
-        return P(axis_name)
-    return P()
+    position embeddings). Thin alias over the shared
+    ``parallel.update_shard.update_shard_spec`` placement rule (mode
+    ``"zero1"``) — kept because the spec is part of the zero1 checkpoint-era
+    API surface."""
+    return update_shard_spec(shape, dp, axis_name, mode="zero1")
 
 
 def zero1_constrain(opt_state: Any, mesh: Mesh, axis_name: str = "dp") -> Any:
@@ -421,17 +440,14 @@ def zero1_constrain(opt_state: Any, mesh: Mesh, axis_name: str = "dp") -> Any:
     which is what makes ~1B-param towers fit v5e HBM. On meshes that also carry
     ``tp``, moments of tp-sharded kernels are re-laid-out dp-wise — still
     correct, with extra resharding comm; the target is the large pure-dp case.
+
+    Deprecated alias for ``constrain_update_sharding(..., mode="zero1")``
+    (parallel/update_shard.py) — the one shared placement helper both step
+    builders now derive their sharding from; ``update_sharding="full"`` grows
+    this into the reduce-scatter / shard-optimizer / gather-publish scheme of
+    arXiv:2004.13336.
     """
-    dp = mesh.shape[axis_name]
-
-    def constrain(x):
-        if not hasattr(x, "shape"):
-            return x
-        return lax.with_sharding_constraint(
-            x, NamedSharding(mesh, _zero1_spec(x.shape, dp, axis_name))
-        )
-
-    return jax.tree.map(constrain, opt_state)
+    return constrain_update_sharding(opt_state, mesh, axis_name, mode="zero1")
 
 
 def _with_pp_shardings(
@@ -517,27 +533,36 @@ def create_train_state(
     ema: bool = False,
     zeros: bool = False,
     pp_axis: str | None = None,
+    update_sharding: str = "",
 ) -> TrainState:
     """Initialize a full train state, every leaf committed to the mesh.
 
-    ``zero1=True`` shards the optimizer state over ``axis_name`` (ZeRO-1); pass
-    the same flag to :func:`make_train_step` so the step keeps it sharded.
+    ``update_sharding`` places the optimizer state per the shared
+    parallel/update_shard.py rule: ``"zero1"`` shards exactly-divisible
+    leaves over ``axis_name`` (``zero1=True`` is the deprecated alias),
+    ``"full"`` shards every leaf with ``shape[0] >= W`` (ragged tails
+    padded) — pass the same mode to :func:`make_train_step` /
+    ``make_compressed_train_step`` so the step keeps the placement.
     ``ema=True`` adds an EMA copy of the params (pair with ``ema_decay`` on
     :func:`make_train_step`). ``zeros=True`` builds a zero-filled state (same
     structure/shardings, no random init) — for checkpoint restore targets.
     ``pp_axis`` shards the block stacks over that axis (see :func:`init_params`);
     adam moments inherit the placement through the jitted create.
     """
+    mode = resolve_update_sharding(update_sharding, zero1)
     params = init_params(rng, model, sample_batch, mesh, zeros=zeros, pp_axis=pp_axis)
 
     # Build the optimizer state under jit too, so every leaf (adam moments follow the
-    # param shardings — or their ZeRO-1 placement — and scalar counters replicate) is
-    # committed to the mesh — required for sharding-stable checkpoint restore.
+    # param shardings — or their update-shard placement — and scalar counters
+    # replicate) is committed to the mesh — required for sharding-stable
+    # checkpoint restore.
     def create(p):
         state = TrainState.create(apply_fn=model.apply, params=p, tx=tx)
-        if zero1:
+        if mode != "off":
             state = state.replace(
-                opt_state=zero1_constrain(state.opt_state, mesh, axis_name)
+                opt_state=constrain_update_sharding(
+                    state.opt_state, mesh, axis_name, mode
+                )
             )
         if ema:
             from distributed_sigmoid_loss_tpu.train.ema import init_ema
@@ -560,6 +585,7 @@ def make_train_step(
     accum_negatives: str = "local",
     accum_dtype: str | None = None,
     gradcache_embed_dtype: str | None = None,
+    update_sharding: str = "",
 ):
     """Build the jitted ``(state, batch) -> (state, metrics)`` step.
 
@@ -585,8 +611,19 @@ def make_train_step(
     unaccumulated big-batch step — the property "local" loses. Cost: one extra
     forward per microbatch (~30% step time at save_hot remat ratios).
 
-    ``zero1=True`` keeps the optimizer state sharded over ``dp`` (ZeRO-1, see
-    :func:`zero1_constrain`); create the state with the same flag.
+    ``update_sharding`` ("off" | "zero1" | "full"; ``zero1=True`` is the
+    deprecated alias for "zero1") places the weight update per
+    parallel/update_shard.py. "zero1" keeps the optimizer state sharded over
+    ``dp`` (see :func:`zero1_constrain`). "full" is the automatic
+    cross-replica update sharding of arXiv:2004.13336: the gradients are
+    constrained to their 1/W shard BEFORE the optax update (XLA's dp
+    all-reduce becomes a reduce-scatter), the optimizer math and state live
+    on the shard, and one all-gather publishes the updated params back at
+    their model shardings (captured from the first concrete state the step
+    sees). Requires a dp axis of size > 1; create the state with the same
+    mode. Numerics are those of the unsharded step (the constraints move
+    placement, not math — clip_by_global_norm and factored adafactor stats
+    reduce over the same global tensors).
 
     ``ema_decay`` maintains the params' exponential moving average in
     ``state.ema`` (decay warmed up per ``ema_decay_schedule``); create the state
@@ -623,6 +660,16 @@ def make_train_step(
     """
     validate_trainable_quant(model)
     axis = loss_cfg.axis_name
+    update_mode = resolve_update_sharding(update_sharding, zero1)
+    if update_mode == "full" and dict(mesh.shape).get(axis, 1) < 2:
+        # Environment refusal (mesh instance, not config space): a 1-wide dp
+        # axis has nothing to scatter over — "full" would silently degrade
+        # to a replicated update while claiming the sharded-memory story.
+        raise ValueError(
+            "update_sharding='full' requires a dp axis of size > 1, got "
+            f"{axis!r}={dict(mesh.shape).get(axis, 1)} on mesh "
+            f"{dict(mesh.shape)}"
+        )
     precision = _precision(loss_cfg.precision)
     # The model's `bias` param plays no role under family="softmax" (zero
     # grad); the uniform per-shard signature keeps one param tree per model.
@@ -669,6 +716,7 @@ def make_train_step(
         moe_aux_weight=moe_aux_weight,
         gradcache_embed_dtype=gradcache_embed_dtype,
         mesh_axis_names=mesh.axis_names,
+        update_sharding=update_sharding,
     )
     if pp_microbatches:
         from distributed_sigmoid_loss_tpu.parallel.pipeline import pipeline_axis
@@ -789,18 +837,20 @@ def make_train_step(
         grads = accum_finish(grad_sum, params, scale=accum_steps)
         return loss_sum / accum_steps, lp, jnp.mean(auxs), grads
 
-    def step(state: TrainState, batch: dict):
+    def step(state: TrainState, batch: dict, param_out_shardings=None):
         loss, lp, aux, grads = grads_and_metrics(state.params, batch)
         prev_step = state.step  # apply_gradients increments; EMA warmup wants
         prev_params = state.params  # update_ratio needs the pre-update tree
-        state = state.apply_gradients(grads=grads)  # the 0-based update index
-        if zero1:
-            # Re-pin the new optimizer state to its ZeRO-1 placement: XLA
-            # propagates the constraint into the adam update, which therefore
-            # consumes reduce-scattered grads and all-gathers the param delta.
-            state = state.replace(
-                opt_state=zero1_constrain(state.opt_state, mesh, axis)
-            )
+        # The shared update-shard recipe (parallel/update_shard.py): plain
+        # apply under "off"; the historical opt-state re-pin under "zero1";
+        # under "full" the grads are constrained to their 1/W shard first
+        # (reduce-scatter), the optax math runs shard-local, and the params
+        # are constrained back to their at-rest shardings (the one gather
+        # publish). The 0-based update index is prev_step.
+        state = apply_sharded_update(
+            state, grads, mesh=mesh, axis_name=axis, mode=update_mode,
+            param_shardings=param_out_shardings,
+        )
         if ema_decay is not None:
             if state.ema is None:
                 raise ValueError(
@@ -839,4 +889,33 @@ def make_train_step(
         "images": NamedSharding(mesh, P(axis)),
         "tokens": NamedSharding(mesh, P(axis)),
     }
-    return jax.jit(step, donate_argnums=(0,)), batch_sharding
+    if update_mode != "full":
+        return jax.jit(step, donate_argnums=(0,)), batch_sharding
+
+    # Full mode: the publish constraint needs the params' at-rest shardings,
+    # which only a CONCRETE state carries — capture them from the first call
+    # and jit once. Abstract tracing (jaxpr audits run the step on
+    # eval_shape states) captures KEEP sentinels and leaves the publish to
+    # the compiler, which is fine trace-side. _cache_size proxies the inner
+    # jit so the no-recompile pins keep one probe for every step flavor.
+    _jitted = []
+
+    def _inner(state):
+        if not _jitted:
+            shardings = capture_shardings(state.params)
+            _jitted.append(jax.jit(
+                lambda s, b: step(s, b, param_out_shardings=shardings),
+                donate_argnums=(0,),
+            ))
+        return _jitted[0]
+
+    def sharded_step(state: TrainState, batch: dict):
+        return _inner(state)(state, batch)
+
+    sharded_step._cache_size = (
+        lambda: _jitted[0]._cache_size() if _jitted else 0
+    )
+    # AOT path (bench.py's step.lower(...).compile()): same capture, same
+    # single inner jit — lowering and calling share one executable.
+    sharded_step.lower = lambda state, batch: _inner(state).lower(state, batch)
+    return sharded_step, batch_sharding

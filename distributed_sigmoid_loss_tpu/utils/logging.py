@@ -101,9 +101,12 @@ class MetricsLogger:
 
     @staticmethod
     def _jsonable(v):
-        # Scalars (device or host) as float; small count vectors (the
-        # adaptive path's compression_scheme_hist) as a list of floats so
-        # the JSONL line stays one self-describing record.
+        # Scalars (device or host) as float; strings (graftshard's
+        # update_sharding mode) as-is; small count vectors (the adaptive
+        # path's compression_scheme_hist) as a list of floats so the JSONL
+        # line stays one self-describing record.
+        if isinstance(v, str):
+            return v
         try:
             return float(v)
         except TypeError:

@@ -1,0 +1,112 @@
+#!/bin/bash
+# Round-18 chip measurement queue — the graftshard round: the dp update
+# path grew full cross-replica sharding (`--update-sharding full`:
+# reduce-scattered grads, shard-local optimizer, one param all-gather —
+# docs/PERF.md "Cross-replica update sharding"), so this round's new
+# entries are (a) the headline stack with the sharded update underneath
+# and (b) the opt-memory/wire attribution A/Bs that turn the CPU-pinned
+# ratios (7.59x opt bytes at W=8, 0.258x adaptive wire at W=4) into
+# ledgered chip numbers.
+#   nohup bash docs/round18_chip_queue.sh > /tmp/r18queue.log 2>&1 &
+#
+# PERF-STREAM DEBT NOTE (carry-forward): the last driver-verified
+# headline is STILL round 3's 761.74 pairs/s/chip (vs_baseline 0.692) —
+# rounds 4/5 recorded no-backend outages and the round-10..17 recipes
+# have no ledgered chip numbers yet. Fifteen rounds of program-level
+# wins are stacked behind one verified measurement; landing chip numbers
+# remains THE debt, and every entry below lands in LEDGER.jsonl with
+# status + fingerprint either way.
+#
+# Same recovery-waiting discipline as rounds 5-17: one bounded probe per
+# cycle until the tunnel answers, then measurements cheapest-first. NEVER
+# signal a running bench process (SIGTERM mid-XLA-compile wedges the
+# tunnel — docs/PERF.md postmortems).
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-17 queue.
+while pgrep -f round17_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+# -1. Chip-free pre-flight BEFORE the probe loop: the graftshard oracles
+#     run whole on the virtual CPU mesh (sgd-delta parity at W in
+#     {2,4,8} incl. ragged + adafactor, the >=0.6*W opt-memory pin, the
+#     1/W compressed-shard wire pin, the no-recompile scheme-swap pin,
+#     zero1->full checkpoint restore, CLI exit-2 pins), then the
+#     full-product lint (now covering the update_sharding axis + the
+#     jaxpr-gather-placement rule) and the proxy regression gate over
+#     the widened 27-config lattice — any failure exits 1 and poisons
+#     the queue log loudly before a chip second is spent.
+set -x
+JAX_PLATFORMS=cpu python -m pytest tests/test_update_shard.py -q -m '' \
+  -p no:cacheprovider
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu lint --full-product
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu obs regress
+set +x
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+set -x
+# 0. Headline anchor first (cached compiles) — the perf stream needs ANY
+#    driver-verified train number this round; its ledger entry carries
+#    the device fingerprint that pins it.
+python bench.py
+
+# 1. The carried headline recipe (bf16 accum + mu + save_hot remat).
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot
+
+# 2. The round-18 A/B pair: the same recipe with the sharded update
+#    underneath — the step-time delta prices the reduce-scatter +
+#    publish restructuring, and opt_mem_bytes_per_replica lands on both
+#    records so the ledger shows the W-fold at-rest drop on real HBM.
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --update-sharding full
+
+# 3. THE round-18 recipe: pallas-int8 x adaptive x sharded-update at the
+#    32k-equiv north-star shape — every per-chip lever in the repo
+#    stacked (streaming int8 Pallas loss, adaptive compressed DCN wire
+#    on the reduce-scattered shard, shard-local optimizer). Its
+#    dcn_wire_bytes should land at ~1/W of round 16's per-tensor
+#    adaptive figure; the CPU pin says 0.258x at W=4.
+python bench.py 1024 30 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --use-pallas --quant-train int8 \
+  --variant all_gather --dcn-slices 2 --grad-compression adaptive \
+  --update-sharding full --metric-suffix _32k_equiv
+
+# 4. Wire attribution A/B at the round-16 shape: adaptive compression
+#    with and without the sharded update, same seed and geometry — the
+#    pair isolates the shard factor in dcn_wire_bytes from the
+#    controller's rung choices.
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather \
+  --dcn-slices 2 --grad-compression adaptive
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather \
+  --dcn-slices 2 --grad-compression adaptive --update-sharding full
+
+# 5. so400m at the zero1 flagship recipe vs full sharding: the model the
+#    optimizer-memory ladder exists for — opt_mem_bytes_per_replica on
+#    the pair is the chip-side version of the 7.59x CPU pin.
+python bench.py 128 10 so400m --accum 8 --accum-bf16 --mu-bf16 \
+  --update-sharding zero1
+python bench.py 128 10 so400m --accum 8 --accum-bf16 --mu-bf16 \
+  --update-sharding full
+
+# 6. Post-run trajectory render for the round summary.
+python -m distributed_sigmoid_loss_tpu obs ledger \
+  --metric siglip_vitb16_train_pairs_per_sec_per_chip

@@ -94,6 +94,7 @@ _STANDARD_MODULES = {
     "test_siege",
     "test_streamed_loss",
     "test_torch_reference_parity",
+    "test_update_shard",
 }
 
 

@@ -209,11 +209,13 @@ def test_fifteen_step_configs_audit_green_and_cover_all_paths():
     }
     all_findings = []
     for label, (closed, kwargs) in jaxprs.items():
-        # check_state_drop and ef_indices are shard_flow kwargs (the same
-        # split audit_default_step_configs makes); audit_jaxpr takes neither.
+        # check_state_drop, ef_indices and update_shard_axis are shard_flow
+        # kwargs (the same split audit_default_step_configs makes);
+        # audit_jaxpr takes none of them.
         audit_kwargs = {
             k: v for k, v in kwargs.items()
-            if k not in ("check_state_drop", "ef_indices")
+            if k not in ("check_state_drop", "ef_indices",
+                         "update_shard_axis")
         }
         all_findings += jaxpr_audit.audit_jaxpr(
             closed, label=label, **audit_kwargs
@@ -409,6 +411,44 @@ def test_collective_order_trips_on_varying_pred_and_replicated_passes():
         "jaxpr-collective-order"
     ]
     assert _flow_rules(branchy(P()), z, p_repl) == []
+
+
+def test_gather_placement_trips_on_pre_update_gather_and_publish_passes():
+    """graftshard's ordering invariant: once grads are reduce-scattered over
+    the update axis, gathering a value derived from the shard re-materializes
+    the full tensor on every replica BEFORE the publish — the W× optimizer
+    saving silently evaporates. The green twin holds the legitimate pair:
+    an embedding all-gather (un-scattered operand) next to a grad
+    reduce-scatter whose shard is returned for a shard-local update."""
+    mesh = _mesh8()
+
+    def bad(g):
+        shard = lax.psum_scatter(g, "dp", scatter_dimension=0, tiled=True)
+        upd = shard * 0.1  # the "optimizer update" on the shard
+        return lax.all_gather(upd, "dp", tiled=True)
+
+    bad_fn = shard_map(
+        bad, mesh=mesh, in_specs=(P(),), out_specs=P(None, None),
+        check_vma=False,
+    )
+    g = jnp.ones((8, 4))
+    assert _flow_rules(bad_fn, g, update_shard_axis="dp") == [
+        "jaxpr-gather-placement"
+    ]
+    # Un-armed (no update sharding in the config): same program, silent.
+    assert _flow_rules(bad_fn, g) == []
+
+    def good(z, gr):
+        emb = lax.all_gather(z, "dp", tiled=True)
+        shard = lax.psum_scatter(gr, "dp", scatter_dimension=0, tiled=True)
+        return emb, shard
+
+    good_fn = shard_map(
+        good, mesh=mesh, in_specs=(P("dp"), P()),
+        out_specs=(P(None, None), P("dp")), check_vma=False,
+    )
+    assert _flow_rules(good_fn, jnp.ones((8, 4)), g,
+                       update_shard_axis="dp") == []
 
 
 def test_rule_catalogs_agree():

@@ -129,6 +129,10 @@ def _bench_args(**overrides):
         # never in the warm cache (dcn_slices/budget/topk_frac are exempt —
         # only meaningful with this trigger flag).
         grad_compression="",
+        # round-18 graftshard: any update-sharding mode restructures the dp
+        # sync (reduce-scatter + shard-local update + publish gather) —
+        # those step programs are never in the warm cache, so it shields.
+        update_sharding="",
     )
     defaults.update(overrides)
     return argparse.Namespace(**defaults)

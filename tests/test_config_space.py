@@ -75,8 +75,11 @@ def test_labels_are_unique_and_stable():
         assert cs.label_of(cfg) == label
     # Non-legacy labels are the non-default axes in AXES order — stable
     # across runs (the per-label trace memo and regress baseline key on it).
-    ring_zero1 = cs.StepConfig(variant="ring", zero1=True)
-    assert cs.label_of(ring_zero1) == "variant=ring+zero1"
+    ring_zero1 = cs.StepConfig(variant="ring", update_sharding="zero1")
+    assert cs.label_of(ring_zero1) == "variant=ring+update_sharding=zero1"
+    assert cs.label_of(cs.StepConfig(update_sharding="full")) == (
+        "update_sharding=full"
+    )
 
 
 def test_full_product_sample_covers_all_legal_pairs():

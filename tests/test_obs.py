@@ -624,6 +624,12 @@ def test_roofline_estimate_contract():
 def test_validate_metrics_contract():
     assert validate_metrics({"loss": 1.0, "grad_norm": 2.0}) == []
     assert validate_metrics({"eval/i2t_recall@1": 0.5}) == []
+    # graftshard fields cli.py stamps when update sharding is on
+    assert validate_metrics(
+        {"loss": 1.0, "update_sharding": "full",
+         "opt_mem_bytes_per_replica": 90872}
+    ) == []
+    assert validate_metrics({"opt_mem_bytes_per_rep1ica": 1}) != []
     bad = validate_metrics({"loss": 1.0, "bogus_metric": 2.0})
     assert len(bad) == 1 and "bogus_metric" in bad[0]
     assert validate_metrics([1]) != []
@@ -653,9 +659,13 @@ def test_metrics_logger_validates_without_losing_lines(capsys):
     assert "schema violation" in err and "bogus_metric" in err
     line = json.loads(buf.getvalue().strip())
     assert line["bogus_metric"] == 2.0  # never lost to its own validator
-    # clean line: no warning
-    logger.log(2, {"loss": 1.0, "eval/i2t_recall@1": 0.3})
+    # clean line: no warning; the string-valued graftshard mode field
+    # survives _jsonable as-is (float("full") raised before PR 17's fix)
+    logger.log(2, {"loss": 1.0, "eval/i2t_recall@1": 0.3,
+                   "update_sharding": "full"})
     assert "schema violation" not in capsys.readouterr().err
+    line = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert line["update_sharding"] == "full"
     # write() with an override schema (health events)
     logger.write({"metric": "health_event", "step": 1, "event": "x",
                   "detail": "d"}, schema=HEALTH_EVENT_FIELDS)
