@@ -330,6 +330,14 @@ _SHIELD_EXEMPT_FLAGS = {
                        "(shield trigger); host-side controller budget — the "
                        "scheme table is a donated operand, recompile-free "
                        "by contract",
+    "controller": "only meaningful with --grad-compression adaptive/learned "
+                  "(shield trigger); host-side policy selection — greedy and "
+                  "budgeted stage the same donated scheme operand, "
+                  "recompile-free by contract",
+    "emu_dcn_mbps": "only meaningful with --grad-compression (shield "
+                    "trigger); the throttled pipe is a host-side subprocess "
+                    "— the compiled program is byte-identical, only the "
+                    "wall clock gains the measured transfer time",
     "topk_frac": "only meaningful with --grad-compression (shield trigger); "
                  "its k does change the compiled program, but never without "
                  "the compression flag that already routes through the "
@@ -1335,14 +1343,16 @@ def main():
                          "track's headline lever (docs/PERF.md roofline "
                          "rationale); recipes tag records via --metric-suffix")
     ap.add_argument("--grad-compression", default="",
-                    choices=["", "int8", "topk", "adaptive"],
+                    choices=["", "int8", "topk", "adaptive", "learned"],
                     help="TRAIN bench with the compressed cross-slice grad "
                          "sync (train/compressed_step.py): hybrid (dcn, dp) "
                          "mesh of --dcn-slices x rest, f32 psum inside each "
-                         "slice + this wire format over dcn; the record "
-                         "gains the wire accounting (dcn_wire_bytes, "
+                         "slice + this wire format over dcn ('learned' = "
+                         "the adaptive ladder plus graftcodec's autoencoder "
+                         "rung, trained during warmup); the record gains "
+                         "the wire accounting (dcn_wire_bytes, "
                          "bits_per_param, ...) for the adaptive-vs-fixed "
-                         "A/Bs in docs/round16_chip_queue.sh")
+                         "A/Bs in docs/round19_chip_queue.sh")
     ap.add_argument("--dcn-slices", type=int, default=0, metavar="N",
                     help="with --grad-compression: size of the mesh's dcn "
                          "axis (>= 2; must divide the device count). On "
@@ -1356,6 +1366,23 @@ def main():
                          "decided during warmup and staged STATICALLY for "
                          "the timed loop, so the measurement has no "
                          "per-step host round-trip")
+    ap.add_argument("--controller", default=None,
+                    choices=["greedy", "budgeted"],
+                    help="with --grad-compression adaptive/learned: bit-"
+                         "controller policy (default greedy) — budgeted "
+                         "allocates a global loss-impact budget via "
+                         "error-per-byte knapsack descent over "
+                         "ef_ratio/gvar/gnorm (docs/PERF.md graftcodec)")
+    ap.add_argument("--emu-dcn-mbps", type=float, default=None,
+                    metavar="MBPS",
+                    help="with --grad-compression: honest DCN emulation "
+                         "(parallel/dcn_emu.py) — each timed call's actual "
+                         "dcn payload crosses a throttled two-process "
+                         "localhost pipe at this bandwidth, the measured "
+                         "transfer time lands in the wall clock, and the "
+                         "record gains dcn_measured_mbps + "
+                         "wire_savings_wallclock_ratio vs the fixed-bf16 "
+                         "reference transfer")
     ap.add_argument("--topk-frac", type=float, default=0.01, metavar="F",
                     help="with --grad-compression topk/adaptive: kept "
                          "fraction of entries per tensor for the top-k wire "
@@ -1587,12 +1614,20 @@ def main():
         if not (0.0 < args.topk_frac <= 1.0):
             ap.error(f"--topk-frac must be in (0, 1], got {args.topk_frac}")
         if (args.dcn_budget_mbps is not None
-                and args.grad_compression != "adaptive"):
+                and args.grad_compression not in ("adaptive", "learned")):
             ap.error("--dcn-budget-mbps applies to --grad-compression "
-                     "adaptive only (fixed schemes have no controller)")
+                     "adaptive/learned only (fixed schemes have no "
+                     "controller)")
         if args.dcn_budget_mbps is not None and args.dcn_budget_mbps <= 0:
             ap.error(f"--dcn-budget-mbps must be > 0, "
                      f"got {args.dcn_budget_mbps}")
+        if (args.controller
+                and args.grad_compression not in ("adaptive", "learned")):
+            ap.error("--controller applies to --grad-compression "
+                     "adaptive/learned only (fixed schemes have no per-round "
+                     "policy to select)")
+        if args.emu_dcn_mbps is not None and args.emu_dcn_mbps <= 0:
+            ap.error(f"--emu-dcn-mbps must be > 0, got {args.emu_dcn_mbps}")
     else:
         # Same anti-silent-no-op rule as the cli train subcommand: a knob
         # that cannot reach the measured program is refused, not dropped.
@@ -1602,6 +1637,13 @@ def main():
         if args.dcn_budget_mbps is not None:
             ap.error("--dcn-budget-mbps without --grad-compression adaptive "
                      "would be a silent no-op")
+        if args.controller:
+            ap.error("--controller without --grad-compression "
+                     "adaptive/learned would be a silent no-op")
+        if args.emu_dcn_mbps is not None:
+            ap.error("--emu-dcn-mbps without --grad-compression would be a "
+                     "silent no-op (there is no dcn mesh axis whose payload "
+                     "the pipe could carry)")
         if args.topk_frac != 0.01:
             ap.error("--topk-frac without --grad-compression would be a "
                      "silent no-op")
@@ -1836,9 +1878,10 @@ def main():
 
         # EF (and the adaptive carry) ride the live state only — the
         # checkpointless bench never sees the strip/restore cycle.
-        if args.grad_compression == "adaptive":
+        if args.grad_compression in ("adaptive", "learned"):
             state = with_adaptive_compression(
-                state, mesh, update_sharding=update_mode
+                state, mesh, update_sharding=update_mode,
+                learned=args.grad_compression == "learned",
             )
         else:
             state = with_error_feedback(
@@ -1919,20 +1962,14 @@ def main():
     # 10 full ViT-B/16 steps "complete" in 7ms), while a float() transfer genuinely
     # drains the queue.
     controller = None
-    if args.grad_compression == "adaptive":
-        # Warmup doubles as the controller's observation window: each warmup
-        # step is wall-timed (the wire-bytes float() genuinely drains the
-        # queue, same tunnel rationale as the loss sync below), then ONE
-        # decision is staged for the timed loop — the measured steady state
-        # has no per-step host round-trip, so adaptive-vs-fixed A/Bs compare
-        # wire formats, not host-sync overhead.
-        import numpy as np
-
+    codec_trainer = None
+    emulator = None
+    controller_sizes = None
+    if (args.grad_compression in ("adaptive", "learned")
+            or args.emu_dcn_mbps is not None):
         from distributed_sigmoid_loss_tpu.parallel.adaptive_compression import (
-            BitController,
             leaf_sizes,
         )
-        from distributed_sigmoid_loss_tpu.train import stage_scheme
 
         if update_mode == "full":
             # The compressor sees the reduce-scattered 1/W shard, so the
@@ -1948,32 +1985,100 @@ def main():
             )
         else:
             controller_sizes = leaf_sizes(state.params)
+    if args.grad_compression in ("adaptive", "learned"):
+        # Warmup doubles as the controller's observation window: each warmup
+        # step is wall-timed (the wire-bytes float() genuinely drains the
+        # queue, same tunnel rationale as the loss sync below), then ONE
+        # decision is staged for the timed loop — the measured steady state
+        # has no per-step host round-trip, so adaptive-vs-fixed A/Bs compare
+        # wire formats, not host-sync overhead. The learned rung's codec
+        # trains during the same window (host PCA of the step's block
+        # moments) and is staged alongside the scheme — both are value
+        # changes of replicated donated operands, never recompiles.
+        import numpy as np
+
+        from distributed_sigmoid_loss_tpu.parallel.adaptive_compression import (
+            BitController,
+            CodecTrainer,
+        )
+        from distributed_sigmoid_loss_tpu.train import stage_codec, stage_scheme
+
         controller = BitController(
             controller_sizes,
             n_dcn=args.dcn_slices,
             topk_frac=args.topk_frac,
             dcn_budget_mbps=args.dcn_budget_mbps,
+            controller=args.controller or "greedy",
+            learned=args.grad_compression == "learned",
         )
+        if args.grad_compression == "learned":
+            codec_trainer = CodecTrainer()
+    if args.emu_dcn_mbps is not None:
+        # Honest DCN emulation: the throttled two-process pipe the timed
+        # loop ships each call's actual payload through (parallel/dcn_emu.py).
+        from distributed_sigmoid_loss_tpu.parallel.dcn_emu import DCNEmulator
+
+        emulator = DCNEmulator(args.emu_dcn_mbps).start()
+        # The fixed-bf16 reference payload per sync round — the same
+        # (n_dcn-1)-hop egress at 2 bytes/param, measured through the SAME
+        # pipe so wire_savings_wallclock_ratio compares wire time with wire
+        # time at this bandwidth.
+        bf16_ref_bytes = (args.dcn_slices - 1) * 2 * int(sum(controller_sizes))
     for _ in range(3):
         tw = time.perf_counter()
         state, metrics = compiled(state, batch)
-        if controller is not None:
+        if controller is not None or emulator is not None:
             wire = float(metrics["dcn_wire_bytes"])  # drains the queue
-            controller.observe(time.perf_counter() - tw, wire)
+            step_dt = time.perf_counter() - tw
+            if emulator is not None:
+                # Observe MEASURED transfer time, not compute-bounded step
+                # time — the controller's bandwidth EWMA reacts to the pipe.
+                transfer_dt = emulator.transfer(wire)
+                if controller is not None:
+                    controller.observe(transfer_dt, wire)
+            elif controller is not None:
+                controller.observe(step_dt, wire)
+        if codec_trainer is not None:
+            codec_trainer.update(np.asarray(state.comp["blockmoment"]))
     float(metrics["loss"])
+    if codec_trainer is not None:
+        state = stage_codec(state, codec_trainer.codec(), mesh)
     if controller is not None:
-        controller.decide(np.asarray(state.comp["ef_ratio"]))
+        controller.decide(
+            np.asarray(state.comp["ef_ratio"]),
+            gnorm=np.asarray(state.comp["gnorm"]),
+            gvar=np.asarray(state.comp["gvar"]),
+        )
         state = stage_scheme(state, controller.scheme, mesh)
+    ref_dt_per_call = 0.0
+    if emulator is not None:
+        # One settle step AFTER staging so the timed loop starts from the
+        # decided scheme/codec, then calibrate the bf16 reference transfer
+        # through the same pipe (median-free mean of 3 — the pipe's pacing
+        # makes repeats tight).
+        state, metrics = compiled(state, batch)
+        float(metrics["dcn_wire_bytes"])
+        ref_times = [
+            emulator.transfer(bf16_ref_bytes * spc) for _ in range(3)
+        ]
+        ref_dt_per_call = sum(ref_times) / len(ref_times)
 
     import contextlib
 
     from distributed_sigmoid_loss_tpu.utils.profiling import trace
 
     profile_ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
+    transfer_total = 0.0
     with profile_ctx:
         t0 = time.perf_counter()
         for _ in range(args.steps // spc):
             state, metrics = compiled(state, batch)
+            if emulator is not None:
+                # The call's ACTUAL payload crosses the throttled pipe; the
+                # float() drains the queue first so transfer time serializes
+                # after compute, exactly as a blocking DCN sync would.
+                wire = float(metrics["dcn_wire_bytes"])
+                transfer_total += emulator.transfer(wire * spc)
         final_loss = float(metrics["loss"])
         dt = time.perf_counter() - t0
     assert jnp.isfinite(final_loss), f"non-finite loss in bench: {final_loss}"
@@ -2081,7 +2186,7 @@ def main():
     if args.grad_compression:
         record["grad_compression"] = args.grad_compression
         record["dcn_slices"] = args.dcn_slices
-        if args.grad_compression in ("topk", "adaptive"):
+        if args.grad_compression in ("topk", "adaptive", "learned"):
             record["topk_frac"] = args.topk_frac
         # The step's own wire accounting (obs/metrics_schema.py fields):
         # per-device DCN egress bytes per sync round and payload bits/param.
@@ -2090,7 +2195,7 @@ def main():
         record["ef_residual_norm"] = round(
             float(metrics["ef_residual_norm"]), 6
         )
-        if args.grad_compression == "adaptive":
+        if args.grad_compression in ("adaptive", "learned"):
             record["compression_scheme_hist"] = [
                 int(x) for x in metrics["compression_scheme_hist"]
             ]
@@ -2099,6 +2204,29 @@ def main():
             )
             if args.dcn_budget_mbps is not None:
                 record["dcn_budget_mbps"] = args.dcn_budget_mbps
+            record["controller_mode"] = controller.mode
+            record["error_budget"] = round(
+                float(controller.last_error_budget), 6
+            )
+        if args.grad_compression == "learned":
+            record["codec_recon_err"] = round(
+                float(metrics["codec_recon_err"]), 6
+            )
+        if emulator is not None:
+            # graftcodec's emulated-DCN measurements: the throttle setting,
+            # the bandwidth MEASURED through the pipe, and the wall-clock
+            # step-time ratio vs the fixed-bf16 reference transfer (> 1 =
+            # the compressed wire saves wall clock at this bandwidth).
+            record["emu_dcn_mbps"] = args.emu_dcn_mbps
+            record["dcn_measured_mbps"] = round(
+                emulator.measured_mbps or 0.0, 2
+            )
+            compute_dt = dt - transfer_total
+            n_calls = args.steps // spc
+            record["wire_savings_wallclock_ratio"] = round(
+                (compute_dt + n_calls * ref_dt_per_call) / dt, 4
+            )
+            emulator.close()
     if hw_flops_per_step_per_dev is not None:
         hw_tflops = hw_flops_per_step_per_dev * args.steps / dt / 1e12
         if hw_tflops >= achieved_model_tflops:

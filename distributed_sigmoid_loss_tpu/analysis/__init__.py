@@ -62,7 +62,7 @@ __all__ = [
 
 # jaxpr rule ids duplicated here (not imported) so listing rules — the CLI's
 # --disable choices — never pays the jax import. The first seven live in
-# jaxpr_audit, the last five in shard_flow; tests/test_analysis.py pins the
+# jaxpr_audit, the last six in shard_flow; tests/test_analysis.py pins the
 # literals against the source catalogs.
 JAXPR_RULES = (
     "jaxpr-ppermute-bijection",
@@ -76,6 +76,7 @@ JAXPR_RULES = (
     "jaxpr-state-drop",
     "jaxpr-collective-order",
     "jaxpr-ef-threaded",
+    "jaxpr-codec-threaded",
     "jaxpr-gather-placement",
 )
 

@@ -63,6 +63,14 @@ BENCH_RECORD_FIELDS = frozenset(
         "grad_compression", "dcn_slices", "dcn_budget_mbps", "topk_frac",
         "dcn_wire_bytes", "bits_per_param", "compression_scheme_hist",
         "ef_residual_norm", "dcn_bw_est_mbps",
+        # graftcodec (--controller / --emu-dcn-mbps): the controller policy
+        # axis + its spent loss-impact budget, the learned rung's
+        # reconstruction error, and the emulated-DCN measurements — the
+        # throttle setting, the bandwidth MEASURED through the pipe, and the
+        # wall-clock step-time ratio vs the fixed-bf16 reference transfer
+        # (> 1 = adaptive saves wall clock at that bandwidth).
+        "controller_mode", "error_budget", "codec_recon_err",
+        "emu_dcn_mbps", "dcn_measured_mbps", "wire_savings_wallclock_ratio",
         # eval-throughput
         "batch", "quant", "fwd_tflops_per_sec_per_chip", "mfu_bf16_basis",
         # context bench

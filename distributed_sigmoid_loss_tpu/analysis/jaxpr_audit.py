@@ -613,6 +613,7 @@ def _abstract_state(
     ef_slices: int | None = None,
     comp_tensors: int | None = None,
     ef_full_w: int | None = None,
+    learned: bool = False,
 ):
     import jax
     import jax.numpy as jnp
@@ -653,12 +654,28 @@ def _abstract_state(
     if comp_tensors is not None:
         # Abstract twin of with_adaptive_compression's carry: one scheme /
         # stat scalar per flattened param leaf, replicated on device.
-        state = state.replace(comp={
+        comp = {
             "scheme": jax.ShapeDtypeStruct((comp_tensors,), jnp.int32),
             "gnorm": jax.ShapeDtypeStruct((comp_tensors,), jnp.float32),
             "gvar": jax.ShapeDtypeStruct((comp_tensors,), jnp.float32),
             "ef_ratio": jax.ShapeDtypeStruct((comp_tensors,), jnp.float32),
-        })
+        }
+        if learned:
+            # graftcodec's learned-rung extension of the carry: the host-
+            # trained codec operands plus the step-written trainer stats
+            # (with_adaptive_compression(..., learned=True) shapes).
+            from distributed_sigmoid_loss_tpu.parallel import (
+                adaptive_compression as ac,
+            )
+
+            g, b, l = ac.CODEC_GROUPS, ac.CODEC_BLOCK, ac.CODEC_LATENT
+            comp.update({
+                "codec_enc": jax.ShapeDtypeStruct((g, b, l), jnp.float32),
+                "codec_dec": jax.ShapeDtypeStruct((g, l, b), jnp.float32),
+                "blockmoment": jax.ShapeDtypeStruct((g, b, b), jnp.float32),
+                "codec_recon_err": jax.ShapeDtypeStruct((), jnp.float32),
+            })
+        state = state.replace(comp=comp)
     return state
 
 
@@ -767,7 +784,7 @@ def _build_step_config(cfg, n_devices: int):
     batch = _abstract_batch(mcfg, local_b * batch_shards)
     tx = make_optimizer(TrainConfig(warmup_steps=1, total_steps=10))
     comp_tensors = None
-    if cfg.compression == "adaptive":
+    if cfg.compression in ("adaptive", "learned"):
         comp_tensors = len(
             jax.tree_util.tree_leaves(_abstract_params(model, batch))
         )
@@ -777,6 +794,7 @@ def _build_step_config(cfg, n_devices: int):
         ef_slices=2 if cfg.error_feedback else None,
         comp_tensors=comp_tensors,
         ef_full_w=dp_size if (full_shard and cfg.error_feedback) else None,
+        learned=cfg.compression == "learned",
     )
 
     loss_cfg = LossConfig(
@@ -817,6 +835,10 @@ def _build_step_config(cfg, n_devices: int):
         # resolves the flag into flattened (invar, outvar) index sets once
         # the trace's output structure is known.
         audit_kwargs["check_ef_threading"] = True
+    if cfg.compression == "learned":
+        # Arms shard_flow's jaxpr-codec-threaded rule the same way: resolved
+        # into (codec_in, stat_out, update_out) positions post-trace.
+        audit_kwargs["check_codec_threading"] = True
     if cfg.pp:
         # GPipe's shift-register carries are drained by design
         # (parallel/pipeline.py); see shard_flow's module docstring.
@@ -862,14 +884,29 @@ def step_config_jaxprs(
             continue
         state, batch, build, kwargs = _build_step_config(cfg, n_devices)
         step = build()
-        if kwargs.pop("check_ef_threading", False):
+        want_ef = kwargs.pop("check_ef_threading", False)
+        want_codec = kwargs.pop("check_codec_threading", False)
+        if want_ef or want_codec:
             closed, out_shape = jax.make_jaxpr(step, return_shape=True)(
                 state, batch
             )
-            kwargs["ef_indices"] = (
-                _leaf_indices_named((state, batch), "ef"),
-                _leaf_indices_named(out_shape, "ef"),
-            )
+            if want_ef:
+                kwargs["ef_indices"] = (
+                    _leaf_indices_named((state, batch), "ef"),
+                    _leaf_indices_named(out_shape, "ef"),
+                )
+            if want_codec:
+                # (codec_in, stat_out, update_out) for jaxpr-codec-threaded:
+                # the codec operands among the inputs, the trainer stats
+                # among the outputs, and the updated params the decode must
+                # reach.
+                kwargs["codec_indices"] = (
+                    _leaf_indices_named((state, batch), "codec_enc")
+                    + _leaf_indices_named((state, batch), "codec_dec"),
+                    _leaf_indices_named(out_shape, "blockmoment")
+                    + _leaf_indices_named(out_shape, "codec_recon_err"),
+                    _leaf_indices_named(out_shape, "params"),
+                )
             cache[label] = (closed, kwargs)
         else:
             cache[label] = (jax.make_jaxpr(step)(state, batch), kwargs)
@@ -914,11 +951,14 @@ def audit_default_step_configs(
         }
         if "ef_indices" in kwargs:
             flow_kwargs["ef_indices"] = kwargs["ef_indices"]
+        if "codec_indices" in kwargs:
+            flow_kwargs["codec_indices"] = kwargs["codec_indices"]
         if "update_shard_axis" in kwargs:
             flow_kwargs["update_shard_axis"] = kwargs["update_shard_axis"]
         base_kwargs = {
             k: v for k, v in kwargs.items()
-            if k not in ("check_state_drop", "ef_indices", "update_shard_axis")
+            if k not in ("check_state_drop", "ef_indices", "codec_indices",
+                         "update_shard_axis")
         }
         findings.extend(audit_jaxpr(closed, label=label, **base_kwargs))
         findings.extend(

@@ -38,6 +38,19 @@ classes the base auditor's communication checks don't see:
   through pjit/remat/shard_map and goes conservative (all-inputs union)
   elsewhere, so it can only under-fire, never false-fire. Armed per config
   via ``ef_indices`` from ``jaxpr_audit.step_config_jaxprs``.
+- ``jaxpr-codec-threaded``: for learned-rung step configs (graftcodec), the
+  codec operands entering the step (``state.comp`` ``codec_enc``/
+  ``codec_dec``, host-trained and replicated) must transitively reach the
+  updated params — a step that takes the codec but never lets the decode
+  touch the gradient path silently trains on the ENCODER-SIDE reconstruction
+  while claiming the learned rung; and the per-round codec stats the host
+  trainer consumes (``blockmoment``, ``codec_recon_err``) must depend on
+  non-codec step inputs (this round's gradient data) — a constant or
+  passed-through stat starves the trainer and freezes the codec at its DCT
+  cold start with nothing ever reporting it. Same ``_outvar_deps`` backward
+  pass as jaxpr-ef-threaded (conservative unions can only under-fire).
+  Armed per config via ``codec_indices`` from
+  ``jaxpr_audit.step_config_jaxprs``.
 - ``jaxpr-gather-placement``: for ``update_sharding="full"`` step configs
   (graftshard), an ``all_gather`` over the update-shard axis whose operand
   was produced (transitively) by a ``psum_scatter``/``reduce_scatter`` over
@@ -80,6 +93,11 @@ SHARD_FLOW_RULES = (
     # through as a pure function of the old residual (see
     # _check_ef_threading; ROADMAP item 2's named rule).
     "jaxpr-ef-threaded",
+    # The learned rung's codec operands must reach the update path and its
+    # host-trainer stats must draw on this round's gradients — never a
+    # dropped decode or a frozen stat (see _check_codec_threading;
+    # graftcodec's named rule).
+    "jaxpr-codec-threaded",
     # Under update_sharding="full", a reduce-scattered value must never be
     # all-gathered back over the shard axis before the optimizer update
     # (see _check_gather_placement; graftshard's named rule).
@@ -394,6 +412,58 @@ def _check_ef_threading(jaxpr, ef_indices, add) -> None:
             )
 
 
+def _check_codec_threading(jaxpr, codec_indices, add) -> None:
+    """jaxpr-codec-threaded: the learned rung's two dataflow obligations.
+
+    ``codec_indices`` is ``(codec_in, stat_out, update_out)`` — flattened
+    positions of the codec operands among the step inputs, the codec stats
+    (blockmoment / codec_recon_err) among the outputs, and the updated-param
+    leaves among the outputs. (1) Every stat output must depend on non-codec
+    step inputs: empty dependence is a constant stat, codec-only dependence
+    is a stat computed from the codec itself — either way the host trainer
+    EWMAs noise and the codec never leaves its DCT cold start. (2) At least
+    one updated-param output must draw on the codec operands: the decode is
+    what turns the wire latents back into a gradient, and a step that drops
+    it applies rung-6 "compression" that never actually happened."""
+    codec_in, stat_out, update_out = codec_indices
+    codec_in_set = frozenset(codec_in)
+    dep_sets = _outvar_deps(jaxpr, {})
+    for o in stat_out:
+        if o >= len(dep_sets):
+            add(
+                "jaxpr-codec-threaded",
+                f"codec stat output index {o} out of range for "
+                f"{len(dep_sets)} outputs — stale codec_indices plumbing",
+            )
+            continue
+        deps = dep_sets[o]
+        if not deps:
+            add(
+                "jaxpr-codec-threaded",
+                f"codec stat output #{o} depends on NO step inputs — a "
+                "constant stat; the host codec trainer would EWMA zeros and "
+                "the learned rung freezes at its DCT cold start",
+            )
+        elif deps <= codec_in_set:
+            add(
+                "jaxpr-codec-threaded",
+                f"codec stat output #{o} depends only on the codec operands "
+                f"(inputs {sorted(deps)}) — not on this round's gradients; "
+                "the trainer's moment stream carries no new information",
+            )
+    live_updates = [o for o in update_out if o < len(dep_sets)]
+    if codec_in and live_updates and not any(
+        dep_sets[o] & codec_in_set for o in live_updates
+    ):
+        add(
+            "jaxpr-codec-threaded",
+            "no updated-param output depends on the codec operands "
+            "(codec_enc/codec_dec) — the learned rung's decode never reaches "
+            "the optimizer update, so the step claims rung-6 compression "
+            "while training on something else entirely",
+        )
+
+
 # ---------------------------------------------------------------------------
 # jaxpr-gather-placement: the graftshard scatter-then-gather taint pass.
 
@@ -479,6 +549,7 @@ def audit_shard_flow(
     bound_axes: dict | None = None,
     check_state_drop: bool = True,
     ef_indices: tuple | None = None,
+    codec_indices: tuple | None = None,
     update_shard_axis: str | None = None,
 ) -> list[Finding]:
     """Run the shard-flow rules over one (closed) jaxpr.
@@ -488,7 +559,9 @@ def audit_shard_flow(
     (``(in_positions, out_positions)`` of the flattened EF-residual leaves,
     computed by jaxpr_audit.step_config_jaxprs for error-feedback configs)
     arms the ``jaxpr-ef-threaded`` dataflow check; None skips it.
-    ``update_shard_axis`` (the dp axis name, set by step_config_jaxprs for
+    ``codec_indices`` (``(codec_in, stat_out, update_out)`` positions, set
+    by step_config_jaxprs for learned-rung configs) arms
+    ``jaxpr-codec-threaded`` the same way. ``update_shard_axis`` (the dp axis name, set by step_config_jaxprs for
     ``update_sharding="full"`` configs) arms ``jaxpr-gather-placement``;
     None skips it.
     """
@@ -507,6 +580,8 @@ def audit_shard_flow(
         _check_state_drops(j, auditor.add)
     if ef_indices is not None:
         _check_ef_threading(j, ef_indices, auditor.add)
+    if codec_indices is not None:
+        _check_codec_threading(j, codec_indices, auditor.add)
     if update_shard_axis is not None:
         _check_gather_placement(j, update_shard_axis, auditor.add)
     return [f for f in auditor.findings if f.rule in SHARD_FLOW_RULES]

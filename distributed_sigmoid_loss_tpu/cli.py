@@ -448,14 +448,14 @@ def _train_config_conflicts(args) -> str | None:
                            "all_gather-only; there is no ring hop loop)")
         if args.ema_decay is not None:
             reasons.append("no --ema-decay")
-        if args.grad_compression in ("topk", "adaptive") and not (
+        if args.grad_compression in ("topk", "adaptive", "learned") and not (
             0 < args.topk_frac <= 1
         ):
             reasons.append(
                 f"--topk-frac in (0, 1], got {args.topk_frac} (it is the "
                 f"fraction of gradient entries kept per tensor)"
             )
-        if args.grad_compression == "adaptive" and args.pp > 1:
+        if args.grad_compression in ("adaptive", "learned") and args.pp > 1:
             reasons.append(
                 "no --pp (the adaptive controller's scheme table is per "
                 "GLOBAL tensor; pp shards block grads stage-locally — use "
@@ -464,15 +464,30 @@ def _train_config_conflicts(args) -> str | None:
         if reasons:
             return "--grad-compression requires: " + "; ".join(reasons)
     if args.topk_frac != 0.01 and args.grad_compression not in (
-        "topk", "adaptive"
+        "topk", "adaptive", "learned"
     ):
         return "--topk-frac without --grad-compression topk is a silent no-op"
-    if args.topk_exact and args.grad_compression not in ("topk", "adaptive"):
+    if args.topk_exact and args.grad_compression not in (
+        "topk", "adaptive", "learned"
+    ):
         return "--topk-exact without --grad-compression topk is a silent no-op"
-    if args.dcn_budget_mbps is not None and args.grad_compression != "adaptive":
+    if args.dcn_budget_mbps is not None and args.grad_compression not in (
+        "adaptive", "learned"
+    ):
         return ("--dcn-budget-mbps without --grad-compression adaptive is a "
                 "silent no-op: only the adaptive bit controller consumes the "
                 "bandwidth budget")
+    if getattr(args, "controller", None) and args.grad_compression not in (
+        "adaptive", "learned"
+    ):
+        return ("--controller without --grad-compression adaptive/learned is "
+                "a silent no-op: the bit controller only exists inside the "
+                "adaptive step wrapper (a fixed scheme has no per-round "
+                "policy to select)")
+    if getattr(args, "emu_dcn_mbps", None) is not None and args.dcn_slices < 2:
+        return ("--emu-dcn-mbps without --dcn-slices >= 2 is a silent no-op: "
+                "the emulated pipe carries the dcn hop's payload, and there "
+                "is no dcn mesh axis (or compressed sync round) to emulate")
     return None
 
 
@@ -792,9 +807,10 @@ def cmd_train(args) -> int:
         )
 
         # ef (and the adaptive carry) ride the live state only; checkpoints never include them (checkpoint._strip_ef), so compressed and plain runs share one checkpoint structure.
-        if args.grad_compression == "adaptive":
+        if args.grad_compression in ("adaptive", "learned"):
             state = with_adaptive_compression(
-                state, mesh, update_sharding=update_mode
+                state, mesh, update_sharding=update_mode,
+                learned=args.grad_compression == "learned",
             )
         else:
             state = with_error_feedback(
@@ -826,25 +842,32 @@ def cmd_train(args) -> int:
             print(f"--grad-compression with --pp {args.pp}: {e}",
                   file=sys.stderr)
             return 2
-        if args.grad_compression == "adaptive":
+        if args.grad_compression in ("adaptive", "learned"):
             # Host-side bit controller around the jitted step: stage the
             # scheme table (a value change of a donated replicated operand —
             # never a recompile), time the step, fold (duration, reported
             # wire bytes) into the bandwidth EWMA, and re-decide from the
-            # step's per-tensor stats. The step duration upper-bounds the
-            # sync duration, so the EWMA UNDER-estimates bandwidth —
-            # conservative narrowing, never optimistic widening. Wrapping
+            # step's per-tensor stats. Without emulation the step duration
+            # upper-bounds the sync duration, so the EWMA UNDER-estimates
+            # bandwidth — conservative narrowing, never optimistic widening;
+            # under --emu-dcn-mbps the payload actually crosses the throttled
+            # pipe and the EWMA tracks MEASURED transfer time. Wrapping
             # step_fn keeps one wiring for both the resilient and plain
             # loops below.
+            import atexit as _atexit
             import time as _time
 
             import numpy as _np
 
             from distributed_sigmoid_loss_tpu.parallel.adaptive_compression import (
                 BitController,
+                CodecTrainer,
                 leaf_sizes,
             )
-            from distributed_sigmoid_loss_tpu.train import stage_scheme
+            from distributed_sigmoid_loss_tpu.train import (
+                stage_codec,
+                stage_scheme,
+            )
 
             if update_mode == "full":
                 # The wire carries the dp reduce-scattered 1/W shard per
@@ -862,23 +885,77 @@ def cmd_train(args) -> int:
                 )
             else:
                 controller_sizes = leaf_sizes(state.params)
+            learned_mode = args.grad_compression == "learned"
+            n_dcn = dict(mesh.shape)["dcn"]
             controller = BitController(
                 controller_sizes,
-                n_dcn=dict(mesh.shape)["dcn"],
+                n_dcn=n_dcn,
                 topk_frac=args.topk_frac,
                 dcn_budget_mbps=args.dcn_budget_mbps,
+                controller=args.controller or "greedy",
+                learned=learned_mode,
             )
+            codec_trainer = CodecTrainer() if learned_mode else None
+            emulator = None
+            bf16_ref_dt = None
+            if args.emu_dcn_mbps is not None:
+                from distributed_sigmoid_loss_tpu.parallel.dcn_emu import (
+                    DCNEmulator,
+                )
+
+                emulator = DCNEmulator(args.emu_dcn_mbps).start()
+                _atexit.register(emulator.close)
+                # The fixed-bf16 reference payload the wall-clock ratio
+                # compares against: the same (n_dcn-1)-hop egress at 2
+                # bytes/param, measured through the SAME pipe so the ratio is
+                # wire time vs wire time, not model vs measurement.
+                bf16_ref_bytes = (n_dcn - 1) * 2 * int(sum(controller_sizes))
             compiled_step = step_fn
 
             def step_fn(st, batch):
+                nonlocal bf16_ref_dt
                 st = stage_scheme(st, controller.scheme, mesh)
                 t0 = _time.perf_counter()
                 st, metrics = compiled_step(st, batch)
                 wire = float(metrics["dcn_wire_bytes"])  # blocks on the step
-                controller.observe(_time.perf_counter() - t0, wire)
-                controller.decide(_np.asarray(st.comp["ef_ratio"]))
+                step_dt = _time.perf_counter() - t0
                 metrics = dict(metrics)
+                if emulator is None:
+                    controller.observe(step_dt, wire)
+                else:
+                    transfer_dt = emulator.transfer(wire)
+                    controller.observe(transfer_dt, wire)
+                    # Re-measure the bf16 reference occasionally (every
+                    # transfer for the first few, then EWMA holds) so the
+                    # ratio tracks the live pipe, not a stale calibration.
+                    if bf16_ref_dt is None or emulator.transfers <= 8:
+                        ref = emulator.transfer(bf16_ref_bytes)
+                        bf16_ref_dt = ref if bf16_ref_dt is None else (
+                            0.5 * ref + 0.5 * bf16_ref_dt
+                        )
+                    metrics["dcn_measured_mbps"] = (
+                        emulator.measured_mbps or 0.0
+                    )
+                    metrics["wire_savings_wallclock_ratio"] = (
+                        (step_dt + bf16_ref_dt) / (step_dt + transfer_dt)
+                    )
+                controller.decide(
+                    _np.asarray(st.comp["ef_ratio"]),
+                    gnorm=_np.asarray(st.comp["gnorm"]),
+                    gvar=_np.asarray(st.comp["gvar"]),
+                )
+                if codec_trainer is not None:
+                    # Host-side codec training from the step's block second
+                    # moments; staging new codec weights is a value change of
+                    # a replicated operand — never a recompile.
+                    new_codec = codec_trainer.update(
+                        _np.asarray(st.comp["blockmoment"])
+                    )
+                    if codec_trainer.rounds >= codec_trainer.warmup_rounds:
+                        st = stage_codec(st, new_codec, mesh)
                 metrics["dcn_bw_est_mbps"] = controller.bw_est_mbps or 0.0
+                metrics["controller_mode"] = controller.mode
+                metrics["error_budget"] = float(controller.last_error_budget)
                 return st, metrics
     else:
         # --loss-impl chunked is an all_gather memory shape; an unset --variant
@@ -2606,16 +2683,20 @@ def main(argv=None) -> int:
                          "(quantization loss on ICI, no bandwidth win — for "
                          "perf experiments emulating a multi-slice topology)")
     tr.add_argument("--grad-compression", "--compression",
-                    choices=["int8", "topk", "adaptive"],
+                    choices=["int8", "topk", "adaptive", "learned"],
                     default="",
                     help="compress the gradient sync over the dcn axis: f32 "
                          "psum on ICI; on DCN either int8 all-gather (~4x "
                          "fewer bytes), top-k sparsification (~50x at the "
-                         "default 1%%), or adaptive — a per-tensor "
+                         "default 1%%), adaptive — a per-tensor "
                          "int8/int4/sign1/top-k scheme chosen each round by "
                          "the bandwidth-aware bit controller "
-                         "(parallel/adaptive_compression.py); all with error "
-                         "feedback (train/compressed_step.py)")
+                         "(parallel/adaptive_compression.py) — or learned: "
+                         "the adaptive ladder plus graftcodec's rung 6, a "
+                         "per-tensor-group linear autoencoder (~0.26 "
+                         "bytes/param) trained online on the host from the "
+                         "step's block moments; all with error feedback "
+                         "(train/compressed_step.py)")
     tr.add_argument("--dcn-budget-mbps", type=float, default=None,
                     metavar="MBPS",
                     help="per-device DCN egress budget for --grad-compression "
@@ -2623,6 +2704,23 @@ def main(argv=None) -> int:
                          "schemes until min(measured-bandwidth EWMA, this "
                          "budget) fits the sync round (unset: measured "
                          "bandwidth alone)")
+    tr.add_argument("--controller", choices=["greedy", "budgeted"],
+                    default=None,
+                    help="bit-controller policy for --grad-compression "
+                         "adaptive/learned (default greedy): greedy narrows "
+                         "the lowest-EF-ratio tensors first; budgeted "
+                         "allocates a global loss-impact budget — per-rung "
+                         "error-per-byte-saved knapsack descent over "
+                         "ef_ratio/gvar/gnorm (docs/PERF.md graftcodec)")
+    tr.add_argument("--emu-dcn-mbps", type=float, default=None,
+                    metavar="MBPS",
+                    help="honest DCN emulation (parallel/dcn_emu.py): ship "
+                         "each round's dcn payload across a throttled "
+                         "two-process localhost pipe at this bandwidth, so "
+                         "dcn_bw_est_mbps reacts to MEASURED transfer time "
+                         "and metrics carry dcn_measured_mbps + "
+                         "wire_savings_wallclock_ratio vs the fixed-bf16 "
+                         "reference; requires --dcn-slices >= 2")
     tr.add_argument("--topk-frac", type=float, default=0.01, metavar="F",
                     help="fraction of entries kept per tensor under "
                          "--grad-compression topk (adaptive: its top-k "

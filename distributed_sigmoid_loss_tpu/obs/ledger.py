@@ -314,6 +314,34 @@ def trajectory(
         if kind:
             point["device_kind"] = kind
         out.setdefault(name, []).append(point)
+    if metric and not out:
+        # Field fallback: the graftcodec emulation figures
+        # (wire_savings_wallclock_ratio, dcn_measured_mbps, error_budget,
+        # ...) are FIELDS stamped on other streams' records, not streams of
+        # their own — `obs ledger --metric wire_savings_wallclock_ratio`
+        # should still render the emulated-A/B trajectory. When no stream
+        # matches, build one from every record carrying the named field; the
+        # unit column names the host stream so the provenance stays visible.
+        for e in entries:
+            rec = e.get("record", {})
+            if metric not in rec or rec.get("metric") == metric:
+                continue
+            point = {
+                "value": rec.get(metric),
+                "unit": f"on {rec.get('metric')}",
+                "status": e.get("status", record_status(rec)),
+                "source": e.get("source", "?"),
+            }
+            if e.get("round") is not None:
+                point["round"] = e["round"]
+            if e.get("ts") is not None:
+                point["ts"] = e["ts"]
+            kind = (
+                rec.get("device_kind") or e.get("env", {}).get("device_kind")
+            )
+            if kind:
+                point["device_kind"] = kind
+            out.setdefault(metric, []).append(point)
     return out
 
 

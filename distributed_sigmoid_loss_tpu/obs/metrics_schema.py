@@ -61,6 +61,14 @@ TRAIN_METRICS_FIELDS = frozenset({
     # mode and the compiler-measured at-rest optimizer bytes per replica
     # (cli.py stamps both on every metrics line when the mode is on).
     "update_sharding", "opt_mem_bytes_per_replica",
+    # graftcodec: the learned rung's relative reconstruction error
+    # (train/compressed_step.py, compression='learned'), the budgeted
+    # controller's spent loss-impact budget + active policy (cli.py adaptive
+    # wrapper), and the emulated-DCN measurements — bandwidth from MEASURED
+    # transfer time over the throttled pipe (parallel/dcn_emu.py) and the
+    # wall-clock step-time ratio vs the fixed-bf16 reference transfer.
+    "codec_recon_err", "error_budget", "controller_mode",
+    "dcn_measured_mbps", "wire_savings_wallclock_ratio",
 })
 
 # Prefix-namespaced families (dynamic keys): the in-training eval hook logs

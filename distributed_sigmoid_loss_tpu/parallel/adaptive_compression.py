@@ -3,7 +3,8 @@
 parallel/compression.py fixes ONE scheme for every tensor (int8 or topk).
 This module makes the scheme a per-tensor runtime choice from a ladder of
 wire formats — int8, packed int4, sign+norm 1-bit (Seide et al. 1-bit SGD),
-top-k at two fractions — selected each sync round by a host-side
+top-k at two fractions, and a host-trained learned linear-autoencoder rung
+(graftcodec) — selected each sync round by a host-side
 :class:`BitController` from (a) per-tensor gradient statistics computed
 in-step (norm / variance / EF-residual-to-gradient ratio, cheap scalars
 pmean'd over dcn alongside the grads) and (b) a measured-DCN-bandwidth EWMA
@@ -17,10 +18,14 @@ of timed sync rounds. The design splits cleanly across the jit boundary:
   collective-order rule can prove the predicate invariant). Changing schemes
   is a VALUE change of that operand, never a recompile.
 - **On the host** (:class:`BitController`): consumes the stats + timing the
-  step emits, keeps the bandwidth EWMA, and greedily narrows tensors (lowest
-  EF-ratio first — the ones compression is hurting least) until the
-  estimated egress fits the budget. Recomputed from scratch each round, so
-  schemes widen again automatically when bandwidth recovers.
+  step emits, keeps the bandwidth EWMA, and narrows tensors until the
+  estimated egress fits the budget — greedily (lowest EF-ratio first — the
+  ones compression is hurting least) or by allocating a global loss-impact
+  budget (``controller="budgeted"``: estimated error per byte saved,
+  knapsack-style). Recomputed from scratch each round, so schemes widen
+  again automatically when bandwidth recovers. :class:`CodecTrainer` is the
+  learned rung's host half: it folds the step's block-moment stat into an
+  EWMA and re-solves the optimal linear codec (PCA) in closed form.
 
 Error feedback is MANDATORY here (the sign/topk rungs are pure bias without
 it): the residual carries whatever the chosen rung dropped into the next
@@ -57,16 +62,25 @@ __all__ = [
     "SCHEME_SIGN1",
     "SCHEME_TOPK",
     "SCHEME_TOPK_LOW",
+    "SCHEME_LEARNED",
     "N_SCHEMES",
     "SCHEME_NAMES",
+    "SCHEME_DISTORTION",
+    "CODEC_BLOCK",
+    "CODEC_LATENT",
+    "CODEC_GROUPS",
     "quantize_tensor_int4",
     "pack_int4",
     "unpack_int4",
     "pack_signs",
     "unpack_signs",
+    "codec_group",
+    "dct_matrix",
+    "default_codec",
     "payload_bytes_table",
     "leaf_sizes",
     "adaptive_axis_mean",
+    "CodecTrainer",
     "BitController",
 ]
 
@@ -79,8 +93,32 @@ SCHEME_INT4 = 1      # 0.5 B/param packed nibbles + scale (8x)
 SCHEME_SIGN1 = 2     # 1 bit/param + mean-|g| scale       (~32x, 1-bit SGD)
 SCHEME_TOPK = 3      # 8 B per kept entry at topk_frac    (~50x at 1%)
 SCHEME_TOPK_LOW = 4  # topk at topk_frac/4                (~200x at 1%)
-N_SCHEMES = 5
-SCHEME_NAMES = ("int8", "int4", "sign1", "topk", "topk_low")
+SCHEME_LEARNED = 5   # learned linear AE latents as int8  (~16x, graftcodec)
+N_SCHEMES = 6
+SCHEME_NAMES = ("int8", "int4", "sign1", "topk", "topk_low", "learned")
+
+# Nominal RELATIVE squared reconstruction error per scheme (fraction of the
+# tensor's gradient power the rung drops before EF recovers it), indexed by
+# scheme code. The budgeted controller's distortion prior: int8/int4 from the
+# uniform-quantizer bound (Δ²/12 at 255/15 levels of a ±max range), sign1
+# from the 1-bit-SGD Gaussian identity (1 - 2/π ≈ 0.36, rounded up for
+# non-Gaussian tails), topk from the energy left in the (1-frac) tail of a
+# heavy-tailed gradient, learned from the starved-sweep measured
+# ``codec_recon_err`` of the PCA codec at 16/64 latents on warm moments.
+# Order is NOT monotone in bytes by construction — the controller clamps
+# Δerror at 0 when a ladder reorders rungs.
+SCHEME_DISTORTION = (1e-4, 4e-3, 0.45, 0.80, 0.95, 0.08)
+
+# graftcodec learned-rung geometry: gradients are chopped into fixed blocks
+# of CODEC_BLOCK consecutive values, each encoded to CODEC_LATENT f32
+# latents by a per-tensor-group linear autoencoder, latents int8-quantized
+# for the wire (CODEC_LATENT/CODEC_BLOCK ≈ 0.25 B/param at the defaults —
+# between int4 and sign1 on the ladder). Two groups: matrices (ndim >= 2,
+# group 0) vs vectors/scalars (group 1) — their block statistics differ
+# enough that one shared basis hurts both.
+CODEC_BLOCK = 64
+CODEC_LATENT = 16
+CODEC_GROUPS = 2
 
 _Q4MAX = 7.0
 
@@ -152,7 +190,11 @@ def payload_bytes_table(size: int, topk_frac: float = 0.01) -> np.ndarray:
     the source of the in-jit ``dcn_wire_bytes`` gather (the step indexes
     this constant table with the scheme operand, so the reported bytes are
     exactly the controller's accounting). Scalar f32 scales count as 4 B;
-    top-k entries as 8 B (f32 value + int32 index)."""
+    top-k entries as 8 B (f32 value + int32 index); the learned rung ships
+    CODEC_LATENT int8 latents per CODEC_BLOCK-sized block plus one scale
+    (codec weights travel separately as a replicated operand, not wire —
+    they are host-trained and identical on every member)."""
+    n_blocks = (size + CODEC_BLOCK - 1) // CODEC_BLOCK
     return np.array(
         [
             size + 4,                              # int8: 1 B/param + scale
@@ -160,9 +202,42 @@ def payload_bytes_table(size: int, topk_frac: float = 0.01) -> np.ndarray:
             (size + 7) // 8 + 4,                   # sign1: 1 bit/param
             8 * _topk_k(size, topk_frac),          # topk
             8 * _topk_k(size, topk_frac / 4.0),    # topk at frac/4
+            CODEC_LATENT * n_blocks + 4,           # learned: int8 latents
         ],
         dtype=np.int64,
     )
+
+
+def codec_group(shape) -> int:
+    """Codec group of a tensor shape: 0 = matrices (ndim >= 2), 1 = the
+    vector/scalar tail. Static per tensor — baked into the traced switch."""
+    return 0 if len(shape) >= 2 else 1
+
+
+def dct_matrix(block: int = CODEC_BLOCK) -> np.ndarray:
+    """Orthonormal DCT-II basis, f32[block, block] (rows = basis vectors).
+
+    The codec's deterministic cold-start: before the trainer has seen any
+    block moments, low-frequency DCT rows are the classic smooth prior for
+    "adjacent gradient entries co-vary" — strictly better than an arbitrary
+    eigh basis of the identity, and seed-free."""
+    k = np.arange(block, dtype=np.float64)
+    basis = np.cos(np.pi * (2.0 * k[None, :] + 1.0) * k[:, None] / (2 * block))
+    basis[0] *= 1.0 / np.sqrt(2.0)
+    return (basis * np.sqrt(2.0 / block)).astype(np.float32)
+
+
+def default_codec(latent: int = CODEC_LATENT) -> dict:
+    """Cold-start codec weights: ``{"enc": f32[G, B, L], "dec": f32[G, L, B]}``.
+
+    enc projects a block onto the first ``latent`` DCT rows; dec is its
+    transpose (orthonormal rows ⇒ the transpose IS the least-squares
+    decoder). Identical for both groups until :class:`CodecTrainer` has
+    moments to specialize them."""
+    rows = dct_matrix()[:latent]                     # (L, B)
+    enc = np.repeat(rows.T[None], CODEC_GROUPS, axis=0)   # (G, B, L)
+    dec = np.repeat(rows[None], CODEC_GROUPS, axis=0)     # (G, L, B)
+    return {"enc": enc.copy(), "dec": dec.copy()}
 
 
 def leaf_sizes(params) -> list:
@@ -223,9 +298,42 @@ def _mean_topk(target, axis_name, n, k, approximate):
     return mean, sent
 
 
+def _codec_blocks(target: jax.Array) -> jax.Array:
+    """``target`` flattened and zero-padded into ``(n_blocks, CODEC_BLOCK)``
+    f32 — the codec's (and the block-moment stat's) common view."""
+    x = target.astype(jnp.float32).ravel()
+    pad = (-x.size) % CODEC_BLOCK
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+    return x.reshape(-1, CODEC_BLOCK)
+
+
+def _mean_learned(target, axis_name, n, enc, dec):
+    """Learned rung: encode blocks → int8-quantize latents → all_gather →
+    decode the latent MEAN (the decoder is linear, so decode-after-mean ==
+    mean-of-decodes at 1/n the decode cost)."""
+    blocks = _codec_blocks(target)                  # (nb, B)
+    z = blocks @ enc                                # (nb, L) latents
+    scale = jnp.maximum(jnp.max(jnp.abs(z)), _EPS) / 127.0
+    q = jnp.clip(jnp.round(z / scale), -127.0, 127.0).astype(jnp.int8)
+    sent = (
+        ((q.astype(jnp.float32) * scale) @ dec)
+        .ravel()[: target.size]
+        .reshape(target.shape)
+    )
+    qs = lax.all_gather(q, axis_name)               # int8 latents on the wire
+    ss = lax.all_gather(scale, axis_name)           # (n,) f32 scales
+    mean_z = jnp.sum(
+        qs.astype(jnp.float32) * ss.reshape((n, 1, 1)), axis=0
+    ) / n
+    mean = (mean_z @ dec).ravel()[: target.size].reshape(target.shape)
+    return mean, sent
+
+
 def adaptive_axis_mean(tree, axis_name: str, ef, scheme, *,
                        topk_frac: float = 0.01,
-                       topk_approximate: bool = True):
+                       topk_approximate: bool = True,
+                       codec=None):
     """Mean of ``tree`` over ``axis_name`` with a per-tensor adaptive wire.
 
     The adaptive sibling of
@@ -234,15 +342,28 @@ def adaptive_axis_mean(tree, axis_name: str, ef, scheme, *,
     REQUIRED (same layout: leading size-1 slice dim per leaf). ``scheme`` is
     the controller's int32[n_tensors] table, REPLICATED over the mesh
     (``P()`` in-spec) — every member switches into the same branch, so each
-    branch's collectives stay matched. All five branches are traced once;
+    branch's collectives stay matched. All six branches are traced once;
     scheme changes are operand-value changes, never recompiles.
+
+    ``codec``: the learned rung's weights, ``{"enc": f32[G, B, L],
+    "dec": f32[G, L, B]}``. ``None`` bakes :func:`default_codec` into the
+    trace as constants (rung 6 still works, but weight updates would
+    recompile — the controller must then keep ``learned=False``). A dict of
+    REPLICATED arrays (``P()`` in-spec, the ``comp`` carry) makes
+    codec-weight updates operand VALUE changes, and arms the two extra
+    codec-training stats below.
 
     Returns ``(mean_tree, new_ef, stats, wire_bytes)``:
 
     - ``stats``: ``{"gnorm", "gvar", "ef_ratio"}`` — f32[n_tensors] each,
       pmean'd over ``axis_name`` (identical on every member), the
       controller's per-tensor inputs. ``ef_ratio`` = ||residual|| / ||grad||
-      measured BEFORE this round's compression.
+      measured BEFORE this round's compression. With a live ``codec``, also
+      ``"blockmoment"`` (f32[G, B, B] — per-group second moment of the
+      compression targets' CODEC_BLOCK blocks, the :class:`CodecTrainer`'s
+      online training signal) and ``"codec_recon_err"`` (f32 scalar — mean
+      relative reconstruction error over the tensors currently ON the
+      learned rung; 0 when none are).
     - ``wire_bytes``: f32 scalar — per-device DCN egress this round,
       ``(n - 1) * sum_i payload_bytes_table(size_i)[scheme_i]``, gathered
       from the constant payload table so it is exactly the controller's own
@@ -258,8 +379,16 @@ def adaptive_axis_mean(tree, axis_name: str, ef, scheme, *,
     flat_t, treedef = jax.tree.flatten(tree)
     flat_e = treedef.flatten_up_to(ef)
     scheme = jnp.clip(scheme.astype(jnp.int32), 0, N_SCHEMES - 1)
+    live_codec = codec is not None
+    if not live_codec:
+        codec = {k: jnp.asarray(v) for k, v in default_codec().items()}
+    enc, dec = codec["enc"], codec["dec"]
 
     means, new_ef, gnorms, gvars, ef_ratios, payloads = [], [], [], [], [], []
+    recon_errs = []
+    moment_sum = [jnp.zeros((CODEC_BLOCK, CODEC_BLOCK), jnp.float32)
+                  for _ in range(CODEC_GROUPS)]
+    block_count = [0] * CODEC_GROUPS
     for i, (t, e) in enumerate(zip(flat_t, flat_e)):
         res = jnp.squeeze(e, 0).astype(jnp.float32)
         g32 = t.astype(jnp.float32)
@@ -268,30 +397,54 @@ def adaptive_axis_mean(tree, axis_name: str, ef, scheme, *,
         gnorms.append(gn)
         gvars.append(jnp.var(g32))
         ef_ratios.append(jnp.sqrt(jnp.sum(res * res)) / (gn + _EPS))
+        group = codec_group(t.shape)
 
         branches = (
-            lambda x: _mean_int8(x, axis_name, n),
-            lambda x: _mean_int4(x, axis_name, n),
-            lambda x: _mean_sign1(x, axis_name, n),
-            lambda x, k=_topk_k(t.size, topk_frac): _mean_topk(
+            lambda x, _e, _d: _mean_int8(x, axis_name, n),
+            lambda x, _e, _d: _mean_int4(x, axis_name, n),
+            lambda x, _e, _d: _mean_sign1(x, axis_name, n),
+            lambda x, _e, _d, k=_topk_k(t.size, topk_frac): _mean_topk(
                 x, axis_name, n, k, topk_approximate
             ),
-            lambda x, k=_topk_k(t.size, topk_frac / 4.0): _mean_topk(
+            lambda x, _e, _d, k=_topk_k(t.size, topk_frac / 4.0): _mean_topk(
                 x, axis_name, n, k, topk_approximate
             ),
+            lambda x, e_, d_: _mean_learned(x, axis_name, n, e_, d_),
         )
-        mean, sent = lax.switch(scheme[i], branches, target)
+        mean, sent = lax.switch(
+            scheme[i], branches, target, enc[group], dec[group]
+        )
         means.append(mean.astype(t.dtype))
         new_ef.append((target - sent)[None])
         payloads.append(
             jnp.asarray(payload_bytes_table(t.size, topk_frac))[scheme[i]]
         )
+        if live_codec:
+            blocks = _codec_blocks(target)
+            moment_sum[group] = moment_sum[group] + blocks.T @ blocks
+            block_count[group] += blocks.shape[0]
+            rel = jnp.sqrt(jnp.sum((target - sent) ** 2)) / (
+                jnp.sqrt(jnp.sum(target * target)) + _EPS
+            )
+            recon_errs.append(
+                jnp.where(scheme[i] == SCHEME_LEARNED, rel, 0.0)
+            )
 
     stats = {
         "gnorm": lax.pmean(jnp.stack(gnorms), axis_name),
         "gvar": lax.pmean(jnp.stack(gvars), axis_name),
         "ef_ratio": lax.pmean(jnp.stack(ef_ratios), axis_name),
     }
+    if live_codec:
+        moment = jnp.stack(
+            [m / max(c, 1) for m, c in zip(moment_sum, block_count)]
+        )
+        on_learned = jnp.sum((scheme == SCHEME_LEARNED).astype(jnp.float32))
+        stats["blockmoment"] = lax.pmean(moment, axis_name)
+        stats["codec_recon_err"] = lax.pmean(
+            jnp.sum(jnp.stack(recon_errs)) / jnp.maximum(on_learned, 1.0),
+            axis_name,
+        )
     wire_bytes = ((n - 1) * jnp.sum(jnp.stack(payloads))).astype(jnp.float32)
     return (
         treedef.unflatten(means),
@@ -299,6 +452,74 @@ def adaptive_axis_mean(tree, axis_name: str, ef, scheme, *,
         stats,
         wire_bytes,
     )
+
+
+class CodecTrainer:
+    """Host-side online trainer for the learned rung's linear autoencoder.
+
+    Deterministic, numpy-only, OUTSIDE jit — the codec twin of
+    :class:`BitController`. Each sync round the training loop feeds it the
+    step's ``blockmoment`` stat (per-group second moment of the compression
+    targets' blocks, already pmean'd); the trainer folds it into a moment
+    EWMA and re-derives the OPTIMAL linear codec for that moment in closed
+    form: the top-``latent`` eigenvectors of the block covariance (the PCA
+    solution — for a linear autoencoder under squared error, gradient
+    descent converges to exactly this subspace, so the 64x64 eigenproblem
+    is solved directly instead of simulating SGD on the host). Eigenvector
+    signs are canonicalized (largest-|component| positive) so retraining is
+    reproducible across runs. Weights go back to the device as a replicated
+    operand via ``train.compressed_step.stage_codec`` — a value change,
+    never a recompile.
+
+    Cold start is the DCT basis (:func:`default_codec`); ``warmup_rounds``
+    moment observations gate the first eigh so one noisy early moment
+    cannot wipe the smooth prior.
+    """
+
+    def __init__(self, *, latent: int = CODEC_LATENT, alpha: float = 0.2,
+                 warmup_rounds: int = 2):
+        self.latent = int(latent)
+        self.alpha = float(alpha)
+        self.warmup_rounds = int(warmup_rounds)
+        self.rounds = 0
+        self.moment: np.ndarray | None = None       # (G, B, B) EWMA
+        self._codec = default_codec(self.latent)
+
+    def codec(self) -> dict:
+        """Current weights: ``{"enc": f32[G, B, L], "dec": f32[G, L, B]}``."""
+        return {k: v.copy() for k, v in self._codec.items()}
+
+    def update(self, blockmoment) -> dict:
+        """Fold one observed ``blockmoment`` (G, B, B) in; return the
+        (possibly re-solved) codec weights."""
+        m = np.asarray(blockmoment, dtype=np.float64)
+        if m.shape != (CODEC_GROUPS, CODEC_BLOCK, CODEC_BLOCK):
+            raise ValueError(
+                "blockmoment must be "
+                f"{(CODEC_GROUPS, CODEC_BLOCK, CODEC_BLOCK)}, got {m.shape}"
+            )
+        if not np.all(np.isfinite(m)):
+            return self.codec()                      # skip poisoned rounds
+        if self.moment is None:
+            self.moment = m
+        else:
+            self.moment = self.alpha * m + (1.0 - self.alpha) * self.moment
+        self.rounds += 1
+        if self.rounds < self.warmup_rounds:
+            return self.codec()
+        enc = np.empty((CODEC_GROUPS, CODEC_BLOCK, self.latent), np.float32)
+        dec = np.empty((CODEC_GROUPS, self.latent, CODEC_BLOCK), np.float32)
+        for g in range(CODEC_GROUPS):
+            sym = 0.5 * (self.moment[g] + self.moment[g].T)
+            _, vecs = np.linalg.eigh(sym)            # ascending eigenvalues
+            top = vecs[:, ::-1][:, : self.latent]    # (B, L), descending
+            flip = np.sign(top[np.abs(top).argmax(axis=0),
+                               np.arange(self.latent)])
+            top = top * np.where(flip == 0, 1.0, flip)
+            enc[g] = top.astype(np.float32)
+            dec[g] = top.T.astype(np.float32)
+        self._codec = {"enc": enc, "dec": dec}
+        return self.codec()
 
 
 class BitController:
@@ -312,14 +533,34 @@ class BitController:
     (``train.compressed_step.stage_scheme``). Decisions are recomputed from
     scratch every round, so tensors WIDEN again when bandwidth recovers.
 
-    Policy: every tensor starts at its widest rung (by measured payload
-    bytes — the per-tensor ladder is ``payload_bytes_table`` sorted
-    descending, robust to topk_frac reordering the rungs); while the
-    estimated per-device egress ``(n_dcn-1) * sum payload`` exceeds
-    ``bytes_allowed = min(bw_est, dcn_budget_mbps) * sync_budget_s``, narrow
-    the not-yet-narrowest tensor with the LOWEST EF-residual-to-gradient
-    ratio one rung (ties: lowest index) — the tensors compression is
-    currently hurting least give up precision first.
+    Two policies behind ``controller=`` (CLI ``--controller``, default
+    greedy for A/B continuity with graftsqueeze):
+
+    - ``"greedy"``: every tensor starts at its widest rung (by measured
+      payload bytes — the per-tensor ladder is ``payload_bytes_table``
+      sorted descending, robust to topk_frac reordering the rungs); while
+      the estimated per-device egress ``(n_dcn-1) * sum payload`` exceeds
+      ``bytes_allowed = min(bw_est, dcn_budget_mbps) * sync_budget_s``,
+      narrow the not-yet-narrowest tensor with the LOWEST
+      EF-residual-to-gradient ratio one rung (ties: lowest index).
+    - ``"budgeted"``: allocate a global loss-impact budget instead
+      (graftcodec; grounding: Zhang et al., arXiv:2407.04272). Each
+      tensor's weight is its estimated loss impact
+      ``w_i = gnorm_i^2 * (1 + ef_ratio_i)`` (gradient power, inflated when
+      compression is already leaving residual behind); each candidate
+      one-rung narrowing is scored by estimated added error per byte saved
+      ``Δerr = (D[next] - D[cur]) * w_i`` over
+      ``Δbytes = (n_dcn-1) * (payload[cur] - payload[next])`` with ``D`` =
+      :data:`SCHEME_DISTORTION`; while over budget, take the cheapest
+      Δerr/Δbytes move (ties: lowest index) — the knapsack greedy on the
+      efficiency ratio. Bytes land within one rung of greedy's, but the
+      error is spent where gradients can afford it. ``last_error_budget``
+      exposes the spent budget (Σ D[scheme_i]·w_i / Σ w_i) for the
+      ``error_budget`` metric.
+
+    ``learned=True`` adds the learned rung (graftcodec rung 6) to every
+    tensor's ladder; the default keeps it out so a plain-adaptive run can
+    never select a scheme whose codec nobody is training.
 
     ``override_bandwidth`` pins the EWMA for tests/drills (the reactivity
     oracle in tests/test_adaptive_compression.py drops it and asserts a
@@ -328,9 +569,14 @@ class BitController:
 
     def __init__(self, sizes, *, n_dcn: int, topk_frac: float = 0.01,
                  dcn_budget_mbps: float | None = None, alpha: float = 0.3,
-                 sync_budget_s: float = 0.1):
+                 sync_budget_s: float = 0.1, controller: str = "greedy",
+                 learned: bool = False):
         if n_dcn < 2:
             raise ValueError(f"BitController needs n_dcn >= 2, got {n_dcn}")
+        if controller not in ("greedy", "budgeted"):
+            raise ValueError(
+                f"controller must be 'greedy' or 'budgeted', got {controller!r}"
+            )
         self.sizes = [int(s) for s in sizes]
         self.n_dcn = int(n_dcn)
         self.topk_frac = float(topk_frac)
@@ -339,14 +585,25 @@ class BitController:
         )
         self.alpha = float(alpha)
         self.sync_budget_s = float(sync_budget_s)
+        self.mode = controller
+        self.learned = bool(learned)
+        self.last_error_budget = 0.0
         self.tables = np.stack(
             [payload_bytes_table(s, topk_frac) for s in self.sizes]
         )                                            # (n_tensors, N_SCHEMES)
-        # Wide→narrow rung order per tensor, by actual payload bytes.
-        self.ladders = np.argsort(-self.tables, axis=1, kind="stable")
+        # Wide→narrow rung order per tensor, by actual payload bytes, over
+        # the ALLOWED schemes only (learned rung gated by ``learned=``).
+        cols = np.array(
+            [c for c in range(N_SCHEMES)
+             if self.learned or c != SCHEME_LEARNED],
+            dtype=np.int64,
+        )
+        self.ladders = cols[
+            np.argsort(-self.tables[:, cols], axis=1, kind="stable")
+        ]                                            # (n_tensors, n_allowed)
         self.bw_est_mbps: float | None = None
         self._overridden = False
-        self.scheme = self.tables.argmax(axis=1).astype(np.int32)  # widest
+        self.scheme = self.ladders[:, 0].astype(np.int32)          # widest
 
     def observe(self, duration_s: float, wire_bytes: float) -> None:
         """Fold one timed sync round into the bandwidth EWMA."""
@@ -381,23 +638,61 @@ class BitController:
         ]
         return int((self.n_dcn - 1) * payload.sum())
 
-    def decide(self, ef_ratio=None) -> np.ndarray:
-        """Next per-tensor scheme table (int32[n_tensors])."""
+    def decide(self, ef_ratio=None, gnorm=None, gvar=None) -> np.ndarray:
+        """Next per-tensor scheme table (int32[n_tensors]).
+
+        ``gnorm``/``gvar`` feed the budgeted policy's loss-impact weights
+        (ignored by greedy); omitted stats degrade to uniform weights, so
+        the first round — before the step has emitted anything — is safe.
+        """
         n = len(self.sizes)
+        n_rungs = self.ladders.shape[1]
         ef_ratio = (
             np.zeros(n) if ef_ratio is None
             else np.asarray(ef_ratio, dtype=np.float64)
         )
+        gnorm = (
+            np.ones(n) if gnorm is None
+            else np.asarray(gnorm, dtype=np.float64)
+        )
         allowed = self.bytes_allowed()
         rung = np.zeros(n, dtype=np.int64)           # all-widest start
-        # Narrowing order: lowest EF ratio first, index as tie-break — fixed
-        # for the round (the ratio measures the CURRENT schemes, not the
-        # candidates, so re-sorting mid-descent would be noise, not signal).
-        order = sorted(range(n), key=lambda i: (ef_ratio[i], i))
-        while self._egress(rung) > allowed:
-            movable = [i for i in order if rung[i] < N_SCHEMES - 1]
-            if not movable:
-                break
-            rung[movable[0]] += 1
+        dist = np.asarray(SCHEME_DISTORTION, dtype=np.float64)
+        weight = np.square(gnorm) * (1.0 + ef_ratio)
+        if not np.all(np.isfinite(weight)) or weight.sum() <= 0:
+            weight = np.ones(n)
+        if self.mode == "greedy":
+            # Narrowing order: lowest EF ratio first, index as tie-break —
+            # fixed for the round (the ratio measures the CURRENT schemes,
+            # not the candidates, so re-sorting mid-descent would be noise,
+            # not signal).
+            order = sorted(range(n), key=lambda i: (ef_ratio[i], i))
+            while self._egress(rung) > allowed:
+                movable = [i for i in order if rung[i] < n_rungs - 1]
+                if not movable:
+                    break
+                rung[movable[0]] += 1
+        else:
+            # Budgeted: knapsack greedy on estimated error per byte saved.
+            while self._egress(rung) > allowed:
+                best, best_key = -1, None
+                for i in range(n):
+                    if rung[i] >= n_rungs - 1:
+                        continue
+                    cur = self.ladders[i, rung[i]]
+                    nxt = self.ladders[i, rung[i] + 1]
+                    dbytes = (self.n_dcn - 1) * max(
+                        int(self.tables[i, cur]) - int(self.tables[i, nxt]),
+                        1,
+                    )
+                    derr = max(dist[nxt] - dist[cur], 0.0) * weight[i]
+                    key = (derr / dbytes, i)
+                    if best_key is None or key < best_key:
+                        best, best_key = i, key
+                if best < 0:
+                    break
+                rung[best] += 1
         self.scheme = self.ladders[np.arange(n), rung].astype(np.int32)
+        spent = float(np.sum(dist[self.scheme] * weight))
+        self.last_error_budget = spent / float(weight.sum() + 1e-12)
         return self.scheme

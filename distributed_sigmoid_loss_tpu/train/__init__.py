@@ -32,6 +32,7 @@ from distributed_sigmoid_loss_tpu.train.ema import (  # noqa: F401
 )
 from distributed_sigmoid_loss_tpu.train.compressed_step import (  # noqa: F401
     make_compressed_train_step,
+    stage_codec,
     stage_scheme,
     with_adaptive_compression,
     with_error_feedback,

@@ -67,10 +67,13 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_sigmoid_loss_tpu.parallel.adaptive_compression import (
+    CODEC_BLOCK,
+    CODEC_GROUPS,
     N_SCHEMES,
     SCHEME_INT8,
     SCHEME_TOPK,
     adaptive_axis_mean,
+    default_codec,
     leaf_sizes,
     payload_bytes_table,
 )
@@ -106,6 +109,7 @@ __all__ = [
     "with_error_feedback",
     "with_adaptive_compression",
     "stage_scheme",
+    "stage_codec",
 ]
 
 
@@ -164,6 +168,7 @@ def with_error_feedback(
 def with_adaptive_compression(
     state: TrainState, mesh: Mesh, dcn_axis: str = "dcn",
     update_sharding: str = "off", axis_name: str = "dp",
+    learned: bool = False,
 ):
     """Attach EF plus the adaptive-compression carry (``state.comp``).
 
@@ -176,6 +181,14 @@ def with_adaptive_compression(
     scheme changes are value changes — never recompiles. Like ``ef``, it is
     derived state: checkpoints strip it (train/checkpoint.py) and restore
     re-attaches a fresh zero carry.
+
+    ``learned=True`` (graftcodec, ``compression="learned"``) grows the carry
+    with the learned rung's exchange slots: host-written codec weights
+    ``codec_enc`` (f32[G, B, L]) / ``codec_dec`` (f32[G, L, B]) staged via
+    :func:`stage_codec` (DCT cold start), and the step-written training
+    stats ``blockmoment`` (f32[G, B, B]) / ``codec_recon_err`` (f32 scalar)
+    the host-side ``CodecTrainer`` consumes. All replicated — codec-weight
+    updates are value changes too.
     """
     state = with_error_feedback(
         state, mesh, dcn_axis=dcn_axis, update_sharding=update_sharding,
@@ -189,6 +202,17 @@ def with_adaptive_compression(
         "gvar": jax.device_put(jnp.zeros((n,), jnp.float32), rep),
         "ef_ratio": jax.device_put(jnp.zeros((n,), jnp.float32), rep),
     }
+    if learned:
+        codec = default_codec()
+        comp["codec_enc"] = jax.device_put(jnp.asarray(codec["enc"]), rep)
+        comp["codec_dec"] = jax.device_put(jnp.asarray(codec["dec"]), rep)
+        comp["blockmoment"] = jax.device_put(
+            jnp.zeros((CODEC_GROUPS, CODEC_BLOCK, CODEC_BLOCK), jnp.float32),
+            rep,
+        )
+        comp["codec_recon_err"] = jax.device_put(
+            jnp.zeros((), jnp.float32), rep
+        )
     return state.replace(comp=comp)
 
 
@@ -207,6 +231,31 @@ def stage_scheme(state: TrainState, scheme, mesh: Mesh) -> TrainState:
         jnp.asarray(scheme, jnp.int32), NamedSharding(mesh, P())
     )
     return state.replace(comp=dict(state.comp, scheme=new))
+
+
+def stage_codec(state: TrainState, codec, mesh: Mesh) -> TrainState:
+    """Stage CodecTrainer-solved learned-rung weights into ``state.comp``.
+
+    ``codec``: ``{"enc": f32[G, B, L], "dec": f32[G, L, B]}`` (the trainer's
+    :meth:`~...adaptive_compression.CodecTrainer.update` return). Same
+    contract as :func:`stage_scheme`: re-placed with the replicated
+    NamedSharding the carry was created with, so an online codec retrain is
+    an operand VALUE change — no reshard, no recompile."""
+    if state.comp is None or "codec_enc" not in state.comp:
+        raise ValueError(
+            "state has no codec carry — create it with "
+            "with_adaptive_compression(state, mesh, learned=True)"
+        )
+    rep = NamedSharding(mesh, P())
+    return state.replace(comp=dict(
+        state.comp,
+        codec_enc=jax.device_put(
+            jnp.asarray(codec["enc"], jnp.float32), rep
+        ),
+        codec_dec=jax.device_put(
+            jnp.asarray(codec["dec"], jnp.float32), rep
+        ),
+    ))
 
 
 def validate_compressed_step_args(
@@ -274,7 +323,7 @@ def validate_compressed_step_args(
             "pp towers are dense (same constraint as make_train_step); "
             "moe_aux_weight requires the non-pp compressed path"
         )
-    if compression not in ("int8", "topk", "adaptive"):
+    if compression not in ("int8", "topk", "adaptive", "learned"):
         raise ValueError(f"unknown compression method: {compression!r}")
     if compression == "topk" and not error_feedback:
         raise ValueError(
@@ -289,12 +338,20 @@ def validate_compressed_step_args(
             "CHANGES lean on it to absorb the transition); create the state "
             "with with_adaptive_compression(state, mesh)"
         )
-    if compression == "adaptive" and pp_microbatches:
+    if compression == "learned" and not error_feedback:
         raise ValueError(
-            "compression='adaptive' with pp_microbatches is not supported: "
-            "the controller's scheme table and stats are per GLOBAL tensor, "
-            "but pp shards block-stack gradients stage-locally — use the "
-            "fixed int8/topk compressed path under pp"
+            "compression='learned' requires error feedback (the learned "
+            "rung's reconstruction bias — like every adaptive rung's "
+            "truncation — is only unbiased through the residual carry); "
+            "create the state with "
+            "with_adaptive_compression(state, mesh, learned=True)"
+        )
+    if compression in ("adaptive", "learned") and pp_microbatches:
+        raise ValueError(
+            f"compression={compression!r} with pp_microbatches is not "
+            "supported: the controller's scheme table and stats are per "
+            "GLOBAL tensor, but pp shards block-stack gradients "
+            "stage-locally — use the fixed int8/topk compressed path under pp"
         )
     if loss_variant != "all_gather":
         raise ValueError(
@@ -407,7 +464,8 @@ def make_compressed_train_step(
         mesh_axis_names=mesh.axis_names,
         update_sharding=update_sharding,
     )
-    adaptive = compression == "adaptive"
+    adaptive = compression in ("adaptive", "learned")
+    learned = compression == "learned"
     n_dcn = dict(mesh.shape)[dcn_axis]
     update_mode = resolve_update_sharding(update_sharding, zero1)
     axis_sizes = dict(mesh.shape)
@@ -529,7 +587,7 @@ def make_compressed_train_step(
             ell = ell + moe_aux_weight * mean_aux
         return ell, lp, mean_aux, grads
 
-    def grads_body(params, images, tokens, ef, scheme=None):
+    def grads_body(params, images, tokens, ef, scheme=None, codec=None):
         if cached_accum:
             ell, lp, aux, grads = cached_grads(params, images, tokens)
         elif accum_steps == 1:
@@ -611,7 +669,7 @@ def make_compressed_train_step(
         if adaptive:
             grads, new_ef, stats, wire_bytes = adaptive_axis_mean(
                 grads, dcn_axis, ef, scheme, topk_frac=topk_frac,
-                topk_approximate=topk_approximate,
+                topk_approximate=topk_approximate, codec=codec,
             )
             if full_shard:
                 # Per-tensor controller stats were computed on this member's
@@ -721,8 +779,14 @@ def make_compressed_train_step(
             )
         if adaptive and state.comp is None:
             raise ValueError(
-                "compression='adaptive' but state.comp is None — create the "
-                "state with with_adaptive_compression(state, mesh)"
+                f"compression={compression!r} but state.comp is None — "
+                "create the state with with_adaptive_compression(state, mesh)"
+            )
+        if learned and "codec_enc" not in (state.comp or {}):
+            raise ValueError(
+                "compression='learned' but state.comp has no codec slots — "
+                "create the state with "
+                "with_adaptive_compression(state, mesh, learned=True)"
             )
         # Specs depend on the param tree structure (per-leaf pp placement), so
         # the shard_map is built at trace time. The synced grads/loss ARE
@@ -736,17 +800,27 @@ def make_compressed_train_step(
             efspec = _ef_specs(state.ef)
             # The scheme table enters REPLICATED (P()) — the per-tensor
             # lax.switch predicate is provably uniform across members, so
-            # every member runs the same branch's collectives.
+            # every member runs the same branch's collectives. Under
+            # compression='learned' the codec weights ride in the same way
+            # (replicated operands, value-change-only), so a host retrain
+            # between rounds never touches the trace.
+            codec_in = (
+                {"enc": state.comp["codec_enc"],
+                 "dec": state.comp["codec_dec"]}
+                if learned else None
+            )
             sharded_grads = jax.shard_map(
-                grads_body,
+                lambda p, im, tk, e, s, c: grads_body(
+                    p, im, tk, e, scheme=s, codec=c
+                ),
                 mesh=mesh,
-                in_specs=(pspec, data_spec, data_spec, efspec, P()),
+                in_specs=(pspec, data_spec, data_spec, efspec, P(), P()),
                 out_specs=(P(), P(), P(), gspec, efspec, P(), P()),
                 check_vma=False,
             )
             loss, lp, aux, grads, new_ef, stats, wire_bytes = sharded_grads(
                 state.params, batch["images"], batch["tokens"], state.ef,
-                state.comp["scheme"],
+                state.comp["scheme"], codec_in,
             )
         elif error_feedback:
             efspec = _ef_specs(state.ef)
@@ -820,6 +894,10 @@ def make_compressed_train_step(
             metrics["compression_scheme_hist"] = jnp.bincount(
                 jnp.clip(scheme_in, 0, N_SCHEMES - 1), length=N_SCHEMES
             )
+            if learned:
+                # Live view of what the learned rung is dropping before EF
+                # recovers it — the CodecTrainer's quality signal.
+                metrics["codec_recon_err"] = stats["codec_recon_err"]
         else:
             # Fixed schemes put a compile-time-constant payload on the wire;
             # emit the same accounting so adaptive-vs-fixed A/Bs read one

@@ -1,0 +1,150 @@
+#!/bin/bash
+# Round-19 chip measurement queue — the graftcodec round: the adaptive
+# wire grew a learned autoencoder rung (`--grad-compression learned`,
+# 0.26 B/param), an error-budgeted controller (`--controller budgeted`),
+# and an honest two-process DCN emulation (`--emu-dcn-mbps` — the dcn
+# payload crosses a throttled localhost pipe, so bandwidth is MEASURED;
+# docs/PERF.md "graftcodec"). This round's new entries are (a) the
+# emulated adaptive-vs-fixed A/B ladder — the first wire numbers in the
+# repo that are wall-clock, not payload-table bytes — and (b) the
+# budgeted-vs-greedy controller A/B at a starved throttle.
+#   nohup bash docs/round19_chip_queue.sh > /tmp/r19queue.log 2>&1 &
+#
+# PERF-STREAM DEBT NOTE (carry-forward): the last driver-verified
+# headline is STILL round 3's 761.74 pairs/s/chip (vs_baseline 0.692) —
+# rounds 4/5 recorded no-backend outages and the round-10..18 recipes
+# have no ledgered chip numbers yet. Sixteen rounds of program-level
+# wins are stacked behind one verified measurement; landing chip numbers
+# remains THE debt. The partial retirement this round: the emulated-DCN
+# ladder below does NOT need the chip to produce real wall-clock wire
+# numbers — it runs on any host, lands in LEDGER.jsonl with status ok +
+# fingerprint, and `wire_savings_wallclock_ratio` becomes the first
+# measured (non-cost-model) perf trajectory since round 3.
+#
+# Same recovery-waiting discipline as rounds 5-18: one bounded probe per
+# cycle until the tunnel answers, then measurements cheapest-first. NEVER
+# signal a running bench process (SIGTERM mid-XLA-compile wedges the
+# tunnel — docs/PERF.md postmortems).
+cd "$(dirname "$0")/.." || exit 1
+
+# Serialize with any still-draining round-18 queue.
+while pgrep -f round18_chip_queue.sh > /dev/null; do sleep 60; done
+
+probe_ok() {
+  DSL_BENCH_PROBE_ATTEMPTS=1 DSL_BENCH_PROBE_TIMEOUT=180 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import probe_backend
+sys.exit(0 if probe_backend() is None else 1)
+EOF
+}
+
+# -1. Chip-free pre-flight BEFORE the probe loop: the graftcodec oracles
+#     run whole on the virtual CPU mesh (learned-rung parity + planted
+#     subspace recovery, the 0.26x wire pin, the no-recompile pin across
+#     online codec retrains, budgeted>=greedy on the starved sweep, the
+#     dcn_emu throttle-honesty/zero-drop pins, CLI exit-2 pins), then
+#     the full-product lint (now covering the controller axis + the
+#     jaxpr-codec-threaded rule) and the proxy regression gate — any
+#     failure exits 1 and poisons the queue log loudly before a chip
+#     second is spent.
+set -x
+JAX_PLATFORMS=cpu python -m pytest tests/test_dcn_emu.py \
+  tests/test_learned_codec.py -q -m '' -p no:cacheprovider
+JAX_PLATFORMS=cpu python -m pytest tests/test_adaptive_compression.py \
+  -q -m '' -p no:cacheprovider
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu lint --full-product
+JAX_PLATFORMS=cpu python -m distributed_sigmoid_loss_tpu obs regress
+set +x
+
+# 0. The emulated-DCN adaptive-vs-fixed ladder — CHIP-FREE wall-clock
+#    wire numbers (runs before the probe loop on purpose: these land
+#    with status ok even during a backend outage). Fixed int8 baseline
+#    vs adaptive(greedy) vs adaptive(budgeted) vs learned(budgeted) at
+#    the same throttled 200 Mbps pipe, same seed and geometry — the
+#    wire_savings_wallclock_ratio on each record is measured transfer
+#    seconds against the fixed-scheme baseline on the SAME pipe.
+set -x
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python bench.py 64 10 tiny \
+  --variant all_gather --dcn-slices 2 --grad-compression int8 \
+  --emu-dcn-mbps 200
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python bench.py 64 10 tiny \
+  --variant all_gather --dcn-slices 2 --grad-compression adaptive \
+  --emu-dcn-mbps 200
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python bench.py 64 10 tiny \
+  --variant all_gather --dcn-slices 2 --grad-compression adaptive \
+  --controller budgeted --emu-dcn-mbps 200
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python bench.py 64 10 tiny \
+  --variant all_gather --dcn-slices 2 --grad-compression learned \
+  --controller budgeted --emu-dcn-mbps 200
+
+# 0b. The starved rung of the ladder: 20 Mbps forces the controllers off
+#     int8 — the budgeted-vs-greedy pair at equal egress is the chip-free
+#     version of the starved-sweep test's loss contract, with wall-clock
+#     wire time attached.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python bench.py 64 10 tiny \
+  --variant all_gather --dcn-slices 2 --grad-compression adaptive \
+  --emu-dcn-mbps 20
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python bench.py 64 10 tiny \
+  --variant all_gather --dcn-slices 2 --grad-compression adaptive \
+  --controller budgeted --emu-dcn-mbps 20
+set +x
+
+for i in $(seq 1 70); do
+  if probe_ok; then
+    echo "probe $i OK — backend is back; starting measurements"
+    break
+  fi
+  echo "probe $i failed; backend still down; sleeping 480s"
+  sleep 480
+done
+
+set -x
+# 1. Headline anchor first (cached compiles) — the perf stream needs ANY
+#    driver-verified train number this round; its ledger entry carries
+#    the device fingerprint that pins it.
+python bench.py
+
+# 2. The carried headline recipe (bf16 accum + mu + save_hot remat).
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot
+
+# 3. THE round-19 recipe: the round-18 full stack with the learned rung
+#    and budgeted controller underneath — pallas-int8 x learned-codec x
+#    budgeted x sharded-update at the 32k-equiv north-star shape. Its
+#    compression_scheme_hist should show rung 6 engaged on the matrix
+#    group and codec_recon_err < 0.05 once the online trainer passes
+#    warmup.
+python bench.py 1024 30 b16 --accum 32 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --use-pallas --quant-train int8 \
+  --variant all_gather --dcn-slices 2 --grad-compression learned \
+  --controller budgeted --update-sharding full --metric-suffix _32k_equiv
+
+# 4. Controller A/B on real chips at the round-16 shape: greedy vs
+#    budgeted, same seed and geometry — the pair isolates the policy
+#    from the ladder (error_budget + controller_mode stamp each record).
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather \
+  --dcn-slices 2 --grad-compression adaptive
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather \
+  --dcn-slices 2 --grad-compression adaptive --controller budgeted
+
+# 5. Learned vs adaptive on chips: does rung 6's 0.26 B/param beat the
+#    fixed-ladder mix the greedy controller picks at the same budget?
+python bench.py 256 30 b16 --accum 16 --accum-bf16 --mu-bf16 \
+  --remat-policy save_hot --variant all_gather \
+  --dcn-slices 2 --grad-compression learned --controller budgeted
+
+# 6. Post-run trajectory renders for the round summary — the second one
+#    is the new measured-wire trajectory this round exists to start.
+python -m distributed_sigmoid_loss_tpu obs ledger \
+  --metric siglip_vitb16_train_pairs_per_sec_per_chip
+python -m distributed_sigmoid_loss_tpu obs ledger \
+  --metric wire_savings_wallclock_ratio

@@ -83,10 +83,12 @@ _STANDARD_MODULES = {
     "test_contrastive",
     "test_core_loss",
     "test_data_pipeline",
+    "test_dcn_emu",
     "test_distindex",
     "test_distributed_parity",
     "test_fleet",
     "test_graftledger",
+    "test_learned_codec",
     "test_lockwatch",
     "test_obs",
     "test_pipeline",

@@ -102,13 +102,15 @@ def test_sign_pack_roundtrip_exact():
 def test_payload_bytes_table_pinned():
     # size=1000, topk_frac=1%: int8 1000+4; int4 500+4; sign1 125+4;
     # topk 8*k(10); topk_low 8*k(round(2.5)=2) — 8 B per kept entry
-    # (f32 value + int32 index), 4 B per f32 scale.
+    # (f32 value + int32 index), 4 B per f32 scale; learned
+    # 16 latents/64-block int8-on-wire: 16*ceil(1000/64)+4 = 260.
     np.testing.assert_array_equal(
-        payload_bytes_table(1000, 0.01), [1004, 504, 129, 80, 16]
+        payload_bytes_table(1000, 0.01), [1004, 504, 129, 80, 16, 260]
     )
-    # Tiny tensors: k clamps at 1, so the "sparse" rungs can be the widest.
+    # Tiny tensors: k clamps at 1, so the "sparse" rungs can be the widest
+    # and the learned rung (one full latent block) is the widest of all.
     np.testing.assert_array_equal(
-        payload_bytes_table(1, 0.01), [5, 5, 5, 8, 8]
+        payload_bytes_table(1, 0.01), [5, 5, 5, 8, 8, 20]
     )
 
 
@@ -653,6 +655,54 @@ def test_adaptive_convergence_parity_sweep():
     assert la[-1] < lu[0], (la, lu)
 
 
+@pytest.mark.slow
+def test_budgeted_matches_or_beats_greedy_on_starved_sweep(adaptive_setup):
+    """graftcodec's controller A/B, on the SAME compiled step and the same
+    moderate starvation (a budget forcing real narrowing but not the floor —
+    at the floor both policies collapse to the identical all-narrowest
+    table and the A/B is vacuous): the budgeted policy must land within the
+    byte budget greedy lands in while matching or beating its loss —
+    spending reconstruction error on low-``gnorm^2*(1+ef_ratio)`` tensors
+    must not lose to spending it on low-ef_ratio ones."""
+    from distributed_sigmoid_loss_tpu.train import stage_scheme
+
+    s = adaptive_setup
+
+    def run(mode):
+        state = s["fresh_adaptive"]()
+        c = BitController(
+            leaf_sizes(state.params), n_dcn=2, controller=mode
+        )
+        # bytes_allowed = 2.4 Mbps * 0.1 s / 8 = 30 kB — ~1/3 of the tiny
+        # model's ~86 kB int8 egress, a mid-ladder working point.
+        c.override_bandwidth(2.4)
+        b = jax.device_put(s["batch"], s["shard_a"])
+        losses, wire = [], 0.0
+        for _ in range(10):
+            scheme = c.decide(
+                np.asarray(state.comp["ef_ratio"]),
+                gnorm=np.asarray(state.comp["gnorm"]),
+                gvar=np.asarray(state.comp["gvar"]),
+            )
+            state = stage_scheme(state, scheme, s["mesh"])
+            state, m = s["step_a"](state, b)
+            losses.append(float(m["loss"]))
+            wire += float(m["dcn_wire_bytes"])
+        return losses, wire, c
+
+    lg, wg, cg = run("greedy")
+    lb, wb, cb = run("budgeted")
+    assert all(np.isfinite(lg)) and all(np.isfinite(lb)), (lg, lb)
+    # Equal bytes: both descents stop at the same 30 kB budget, so the
+    # cumulative wire may differ only by the one-rung stopping granularity.
+    assert wb <= wg * 1.1, (wb, wg)
+    # Match-or-beat at that budget (2% slack for CPU-order noise).
+    assert lb[-1] <= lg[-1] * 1.02, (lb[-1], lg[-1])
+    assert cb.mode == "budgeted" and cb.last_error_budget > 0
+    # Same executable served both policies: scheme tables are operands.
+    assert s["step_a"]._cache_size() == 1
+
+
 # -------------------------------------------------- derived-state lifecycle
 
 
@@ -903,7 +953,7 @@ def test_bench_adaptive_refusals_exit_2():
                 "--grad-compression", "int8", "--dcn-slices", "2",
                 "--variant", "all_gather", "--dcn-budget-mbps", "9",
             ],
-            "adaptive only",
+            "adaptive/learned only",
         ),
         (
             ["--grad-compression", "adaptive", "--dcn-slices", "2"],

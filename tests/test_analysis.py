@@ -209,13 +209,13 @@ def test_fifteen_step_configs_audit_green_and_cover_all_paths():
     }
     all_findings = []
     for label, (closed, kwargs) in jaxprs.items():
-        # check_state_drop, ef_indices and update_shard_axis are shard_flow
-        # kwargs (the same split audit_default_step_configs makes);
-        # audit_jaxpr takes none of them.
+        # check_state_drop, ef_indices, update_shard_axis and codec_indices
+        # are shard_flow kwargs (the same split audit_default_step_configs
+        # makes); audit_jaxpr takes none of them.
         audit_kwargs = {
             k: v for k, v in kwargs.items()
             if k not in ("check_state_drop", "ef_indices",
-                         "update_shard_axis")
+                         "update_shard_axis", "codec_indices")
         }
         all_findings += jaxpr_audit.audit_jaxpr(
             closed, label=label, **audit_kwargs
@@ -449,6 +449,127 @@ def test_gather_placement_trips_on_pre_update_gather_and_publish_passes():
     )
     assert _flow_rules(good_fn, jnp.ones((8, 4)), g,
                        update_shard_axis="dp") == []
+
+
+def _codec_findings(fn, args, codec_indices):
+    closed = jax.make_jaxpr(fn)(*args)
+    return [
+        f
+        for f in shard_flow.audit_shard_flow(
+            closed, label="fixture", codec_indices=codec_indices
+        )
+        if f.rule == "jaxpr-codec-threaded"
+    ]
+
+
+def test_codec_threaded_trips_on_broken_fixtures_and_threaded_passes():
+    """graftcodec's dataflow rule, falsified both ways: (1) a codec stat
+    output that is constant (the host trainer would EWMA zeros — DCT
+    freeze) or computed only FROM the codec operands (no new information),
+    and (2) an update output that never touches the codec (the decode
+    dropped — rung-6 compression that never happened). The green twin
+    threads both: stats from the gradients, params through decode."""
+    g = jnp.ones((4, 64))
+    enc = jnp.full((64, 16), 0.1)
+    dec = jnp.full((16, 64), 0.1)
+    # Positional layout shared by all fixtures: inputs (grad, enc, dec),
+    # outputs (params, stat) -> codec_in=(1, 2), stat_out=(1,), update=(0,).
+    idx = ((1, 2), (1,), (0,))
+
+    @jax.jit
+    def good(grad, e, d):
+        params = (grad @ e) @ d                      # decode reaches update
+        stat = grad.T @ grad                         # moment of the grads
+        return params, stat
+
+    assert _codec_findings(good, (g, enc, dec), idx) == []
+
+    @jax.jit
+    def bad_const_stat(grad, e, d):
+        return (grad @ e) @ d, jnp.zeros((64, 64))
+
+    found = _codec_findings(bad_const_stat, (g, enc, dec), idx)
+    assert len(found) == 1 and "constant stat" in found[0].detail
+
+    @jax.jit
+    def bad_codec_only_stat(grad, e, d):
+        return (grad @ e) @ d, d.T @ d               # moment of the codec
+
+    found = _codec_findings(bad_codec_only_stat, (g, enc, dec), idx)
+    assert len(found) == 1 and "only on the codec operands" in found[0].detail
+
+    @jax.jit
+    def bad_decode_dropped(grad, e, d):
+        return grad * 2.0, grad.T @ grad             # codec never consulted
+
+    found = _codec_findings(bad_decode_dropped, (g, enc, dec), idx)
+    assert len(found) == 1 and "never reaches" in found[0].detail
+    # Un-armed (no codec_indices): the same broken program is silent — the
+    # rule only exists for configs that claim the learned rung.
+    closed = jax.make_jaxpr(bad_decode_dropped)(g, enc, dec)
+    assert [
+        f for f in shard_flow.audit_shard_flow(closed, label="fixture")
+        if f.rule == "jaxpr-codec-threaded"
+    ] == []
+
+
+def test_codec_threaded_sees_through_shard_map():
+    """The decode-dropped fixture hidden inside a jitted shard_map body —
+    the positional recursion must follow it rather than go conservative
+    (conservative would union ALL inputs and the rule could never fire)."""
+    mesh = _mesh8()
+
+    def make(fix):
+        def body(grad, e, d):
+            stat = lax.pmean(grad.T @ grad, "dp")
+            if fix == "dropped":
+                return grad * 2.0, stat
+            return (grad @ e) @ d, stat
+
+        return jax.jit(
+            shard_map(
+                body, mesh=mesh, in_specs=(P("dp"), P(), P()),
+                out_specs=(P("dp"), P()), check_vma=False,
+            )
+        )
+
+    g = jnp.ones((8, 64))
+    enc = jnp.full((64, 16), 0.1)
+    dec = jnp.full((16, 64), 0.1)
+    idx = ((1, 2), (1,), (0,))
+    found = _codec_findings(make("dropped"), (g, enc, dec), idx)
+    assert len(found) == 1 and "never reaches" in found[0].detail
+    assert _codec_findings(make("good"), (g, enc, dec), idx) == []
+
+
+@pytest.mark.slow
+def test_learned_step_config_arms_codec_indices():
+    """The shipped learned-step configs trace with resolved codec_indices
+    (codec operands in, blockmoment/codec_recon_err + params out) and run
+    the rule green — the self-enforcement half of the graftcodec tentpole."""
+    from distributed_sigmoid_loss_tpu.analysis.jaxpr_audit import (
+        step_config_jaxprs,
+    )
+
+    jaxprs = step_config_jaxprs(8)
+    label = "compression=learned+error_feedback"
+    assert label in jaxprs
+    closed, kw = jaxprs[label]
+    codec_in, stat_out, update_out = kw["codec_indices"]
+    assert codec_in and stat_out and update_out
+    found = [
+        f
+        for f in shard_flow.audit_shard_flow(
+            closed, label=label, codec_indices=kw["codec_indices"]
+        )
+        if f.rule == "jaxpr-codec-threaded"
+    ]
+    assert found == [], [str(f) for f in found]
+    # The adaptive (non-learned) config must NOT arm the rule: there is no
+    # codec operand to thread.
+    assert "codec_indices" not in jaxprs[
+        "compression=adaptive+error_feedback"
+    ][1]
 
 
 def test_rule_catalogs_agree():
@@ -704,6 +825,60 @@ def test_fleet_stats_fields_registered_both_sides():
     )
     assert _rules_of(bad_rec) == ["repo-bench-record"]
     assert bad_rec[0].subject == "bench.py::bogus_fleet_field"
+
+
+def test_graftcodec_fields_registered_both_sides():
+    """graftcodec schema, both sides: the five new fields
+    (codec_recon_err / error_budget / controller_mode / dcn_measured_mbps /
+    wire_savings_wallclock_ratio) ride the train metrics line AND the bench
+    record, with an invented neighbor tripping each registry (the
+    falsification half — a typo'd stamp must not validate)."""
+    good_line = (
+        'metrics = {"loss": 1, "codec_recon_err": 0.03,\n'
+        '           "error_budget": 0.12, "controller_mode": "budgeted",\n'
+        '           "dcn_measured_mbps": 184.2,\n'
+        '           "wire_savings_wallclock_ratio": 1.31}\n'
+    )
+    assert repo_lint.check_metrics_schema(
+        sources={"train/compressed_step.py": good_line}
+    ) == []
+    bad_line = repo_lint.check_metrics_schema(
+        sources={"cli.py": 'metrics = {"codec_recon_errz": 0.03}\n'}
+    )
+    assert [f.subject for f in bad_line] == ["cli.py::codec_recon_errz"]
+    # Direct validator fixtures (what the CLI stamps each step under
+    # --grad-compression learned --emu-dcn-mbps).
+    from distributed_sigmoid_loss_tpu.obs.metrics_schema import (
+        validate_metrics,
+    )
+
+    assert validate_metrics({
+        "codec_recon_err": 0.03, "error_budget": 0.12,
+        "controller_mode": "budgeted", "dcn_measured_mbps": 184.2,
+        "wire_savings_wallclock_ratio": 1.31,
+    }) == []
+    assert validate_metrics({"wire_savings_wallclock_ration": 1.3}) != []
+    # Bench-record side: the emulated-A/B stamps are registered...
+    assert repo_lint.check_bench_record_fields(
+        'record = {"metric": "m", "controller_mode": "greedy",\n'
+        '          "error_budget": 0.02, "codec_recon_err": 0.04,\n'
+        '          "emu_dcn_mbps": 200.0, "dcn_measured_mbps": 171.5,\n'
+        '          "wire_savings_wallclock_ratio": 1.22}\n'
+    ) == []
+    rec = {
+        "metric": "m", "value": 1.0, "unit": "u",
+        "controller_mode": "budgeted", "error_budget": 0.1,
+        "codec_recon_err": 0.02, "emu_dcn_mbps": 200.0,
+        "dcn_measured_mbps": 171.5, "wire_savings_wallclock_ratio": 1.22,
+    }
+    assert validate_record(rec) == []
+    # ...and the invented neighbor trips both registries.
+    assert validate_record({**rec, "dcn_measured_mbpz": 1.0}) != []
+    bad_rec = repo_lint.check_bench_record_fields(
+        'record = {"metric": "m", "emu_dcn_mbpz": 200.0}\n'
+    )
+    assert _rules_of(bad_rec) == ["repo-bench-record"]
+    assert bad_rec[0].subject == "bench.py::emu_dcn_mbpz"
 
 
 def test_metrics_schema_green_on_shipped_tree():

@@ -208,6 +208,23 @@ def test_fresh_compile_config_covers_streamed_loss_and_overlap():
     )
 
 
+def test_fresh_compile_config_covers_graftcodec_flags():
+    """Round-19 graftcodec: the learned rung rides the existing
+    --grad-compression shield trigger (a sixth lax.switch branch is still a
+    fresh hybrid-mesh step program), while --controller / --emu-dcn-mbps are
+    host-side — exempt WITH rationale, and refused by argparse without the
+    trigger flag, so the no-flag-unclassified invariant stays total."""
+    bench = _bench_module()
+    assert bench._fresh_compile_config(_bench_args(grad_compression="learned"))
+    assert bench._fresh_compile_config(
+        _bench_args(grad_compression="adaptive")
+    )
+    assert not bench._fresh_compile_config(_bench_args(grad_compression=""))
+    for flag in ("controller", "emu_dcn_mbps"):
+        rationale = bench._SHIELD_EXEMPT_FLAGS[flag]
+        assert "shield trigger" in rationale, flag
+
+
 def test_fresh_compile_config_covers_quant_train():
     """Round-6: the STE-quantized train step (--quant-train int8) swaps every
     projection dot for the int8 custom_vjp program — never in the warm cache

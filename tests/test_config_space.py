@@ -110,6 +110,43 @@ def test_full_product_sample_covers_all_legal_pairs():
     assert not missing, sorted(missing)[:5]
 
 
+def test_graftcodec_rows_registered():
+    """graftcodec's axes land in the feature model: the learned compression
+    value, the controller axis, and the three constraint rows that make the
+    new corner refusable by the solver exactly where the code refuses it."""
+    assert "learned" in cs.AXES["compression"]
+    assert cs.AXES["controller"] == ("", "greedy", "budgeted")
+    assert cs.is_legal(
+        cs.StepConfig(compression="learned", error_feedback=True)
+    )
+    assert cs.is_legal(
+        cs.StepConfig(
+            compression="adaptive", error_feedback=True,
+            controller="budgeted",
+        )
+    )
+    no_ef = cs.violations(cs.StepConfig(compression="learned"))
+    assert any(v.name == "learned-needs-error-feedback" for v in no_ef)
+    with_pp = cs.violations(
+        cs.StepConfig(compression="learned", error_feedback=True, pp=True)
+    )
+    assert any(v.name == "adaptive-excludes-pp" for v in with_pp)
+    orphan = cs.violations(cs.StepConfig(controller="budgeted"))
+    assert any(v.name == "controller-needs-adaptive" for v in orphan)
+    assert any(
+        v.name == "controller-needs-adaptive"
+        for v in cs.violations(
+            cs.StepConfig(compression="int8", controller="greedy")
+        )
+    )
+    # The learned corners are in the traced tier-1 sample (the auditor's
+    # jaxpr-codec-threaded rule needs a jaxpr to walk).
+    tier1 = cs.tier1_sample()
+    assert "compression=learned+error_feedback" in tier1
+    assert "compression=learned+controller=budgeted+error_feedback" in tier1
+    assert "compression=learned+error_feedback+update_sharding=full" in tier1
+
+
 # ---------------------------------------------------------------------------
 # the drift probe: solver vs the real imperative refusals
 # ---------------------------------------------------------------------------

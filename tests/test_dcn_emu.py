@@ -1,0 +1,112 @@
+"""Honest DCN emulation (graftcodec): the throttled two-process pipe.
+
+Oracles for parallel/dcn_emu.py — the module that turns the single-slice
+"virtual dcn axis" caveat into measured wall-clock wire time:
+
+- throttle honesty in BOTH directions: a multi-chunk payload's measured
+  bandwidth lands within 2x of the configured throttle (above AND below —
+  the dryrun token's pin), and a slower throttle measurably slows the same
+  payload;
+- zero silent drops: the client raises RuntimeError on any sent/acked byte
+  mismatch (exercised against an in-test lying sink — the real sink cannot
+  be made to drop without killing it);
+- accounting (``transfers`` / ``bytes_total`` / ``measured_mbps`` EWMA),
+  zero-byte transfers are free and uncounted, shutdown is clean (sink exit
+  code 0, double-close safe), and non-positive bandwidths are refused.
+
+Stdlib-only module, stdlib-only tests: no jax import on either side, so the
+whole file runs in milliseconds-to-seconds and stays conftest-standard.
+"""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from distributed_sigmoid_loss_tpu.parallel.dcn_emu import DCNEmulator
+
+_HDR = struct.Struct("<q")
+
+
+def test_throttle_honest_within_2x_and_reacts_to_rate():
+    # 2 MiB = 32 drain chunks: serialization delay dominates the RTT floor.
+    payload = 2 * 1024 * 1024
+    with DCNEmulator(200.0) as emu:
+        emu.transfer(payload)                        # settle: connect skew
+        for _ in range(3):
+            dt = emu.transfer(payload)
+            assert dt > 0.0
+        fast = emu.measured_mbps
+    assert 100.0 <= fast <= 400.0, fast              # within 2x of 200
+    # A 10x slower throttle on the same payload: measurably slower pipe.
+    with DCNEmulator(20.0) as emu:
+        emu.transfer(256 * 1024)
+        slow_dt = emu.transfer(payload)
+    ideal = payload * 8.0 / (20.0 * 1e6)             # ~0.84 s at 20 Mbps
+    assert slow_dt >= 0.5 * ideal, (slow_dt, ideal)
+    assert 10.0 <= emu.measured_mbps <= 40.0, emu.measured_mbps
+
+
+def test_transfer_accounting_and_zero_bytes_free():
+    with DCNEmulator(500.0) as emu:
+        assert emu.transfer(0) == 0.0
+        assert emu.transfer(-5) == 0.0
+        assert emu.transfers == 0 and emu.bytes_total == 0
+        emu.transfer(1000)
+        emu.transfer(3000)
+        assert emu.transfers == 2
+        assert emu.bytes_total == 4000
+        assert emu.measured_mbps is not None and emu.measured_mbps > 0
+
+
+def test_dropped_bytes_raise_loudly():
+    """The zero-silent-drops contract: a sink that acks the wrong byte count
+    must surface as RuntimeError, never as a faster measurement. The honest
+    sink can't be made to drop, so the fixture is a lying one."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def lying_sink():
+        conn, _ = srv.accept()
+        srv.close()
+        with conn:
+            (length,) = _HDR.unpack(conn.recv(_HDR.size))
+            got = 0
+            while got < length:
+                buf = conn.recv(min(65536, length - got))
+                if not buf:
+                    return
+                got += len(buf)
+            conn.sendall(_HDR.pack(got - 1))         # one byte "lost"
+
+    t = threading.Thread(target=lying_sink, daemon=True)
+    t.start()
+    emu = DCNEmulator(100.0)
+    emu._sock = socket.create_connection(("127.0.0.1", port))
+    try:
+        with pytest.raises(RuntimeError, match="dropped bytes"):
+            emu.transfer(10_000)
+        # A failed transfer must not pollute the accounting.
+        assert emu.transfers == 0 and emu.bytes_total == 0
+    finally:
+        emu._sock.close()
+        emu._sock = None
+        t.join(timeout=5)
+
+
+def test_shutdown_clean_and_double_close_safe():
+    emu = DCNEmulator(300.0).start()
+    proc = emu._proc
+    emu.transfer(4096)
+    emu.close()
+    assert proc.returncode == 0                      # shutdown header honored
+    emu.close()                                      # idempotent
+    assert emu._sock is None and emu._proc is None
+
+
+def test_nonpositive_bandwidth_refused():
+    with pytest.raises(ValueError, match="> 0 Mbps"):
+        DCNEmulator(0.0)
+    with pytest.raises(ValueError, match="> 0 Mbps"):
+        DCNEmulator(-5.0)

@@ -149,6 +149,46 @@ def test_committed_ledger_holds_the_backfilled_trajectory():
     assert s["excluded"] >= 2  # r04/r05 outages excluded from baselines
 
 
+def test_trajectory_field_fallback_renders_graftcodec_fields(tmp_path):
+    """graftcodec's emulation figures (wire_savings_wallclock_ratio,
+    dcn_measured_mbps, ...) are FIELDS on other streams' records, not metric
+    streams of their own — `--metric <field>` must still render them, with
+    the host stream named in the unit column for provenance."""
+    path = str(tmp_path / "ledger.jsonl")
+    ledger_mod.append_record(
+        {"metric": "siglip_vittiny_train_pairs_per_sec_per_chip",
+         "value": 900.0, "unit": "pairs/s/chip", "emu_dcn_mbps": 200.0,
+         "dcn_measured_mbps": 184.2, "wire_savings_wallclock_ratio": 1.31},
+        path=path,
+    )
+    ledger_mod.append_record(
+        {"metric": "siglip_vittiny_train_pairs_per_sec_per_chip",
+         "value": 880.0, "unit": "pairs/s/chip", "emu_dcn_mbps": 20.0,
+         "dcn_measured_mbps": 18.7, "wire_savings_wallclock_ratio": 2.05},
+        path=path,
+    )
+    entries = ledger_mod.read_ledger(path)
+    traj = ledger_mod.trajectory(
+        entries, metric="wire_savings_wallclock_ratio"
+    )
+    pts = traj["wire_savings_wallclock_ratio"]
+    assert [p["value"] for p in pts] == [1.31, 2.05]
+    assert all(
+        p["unit"] == "on siglip_vittiny_train_pairs_per_sec_per_chip"
+        for p in pts
+    )
+    assert all(p["status"] == "ok" for p in pts)
+    # a real stream by that name still wins over the fallback
+    assert "wire_savings_wallclock_ratio" not in ledger_mod.trajectory(entries)
+
+    from distributed_sigmoid_loss_tpu.cli import main
+
+    assert main(["obs", "ledger", "--ledger", path,
+                 "--metric", "wire_savings_wallclock_ratio"]) == 0
+    assert main(["obs", "ledger", "--ledger", path,
+                 "--metric", "dcn_measured_mbps"]) == 0
+
+
 def test_diff_records_fields_and_deltas():
     a = {"metric": "m", "value": 100.0, "unit": "x", "gone": 1}
     b = {"metric": "m", "value": 110.0, "unit": "x", "new": 2}
